@@ -1,0 +1,26 @@
+"""lighthouse_tpu — a TPU-native Ethereum consensus-client framework.
+
+Brand-new design with the capabilities of sigp/lighthouse (reference mounted at
+/root/reference, cited throughout as `file:line`), built array-first for
+JAX/XLA/Pallas on TPU:
+
+- ``crypto``   — BLS12-381 / KZG / SHA-256 with pluggable backends
+                 (cpu C++, fake, tpu JAX kernels), mirroring the backend-generic
+                 design of crypto/bls/src/lib.rs:86-141.
+- ``ops``      — the TPU kernels themselves (vmapped SHA-256 hash-tree,
+                 limb-decomposed BLS12-381 pairing, shuffling).
+- ``sszb``     — SSZ serialization + merkleization (ethereum_ssz/tree_hash
+                 equivalent).
+- ``specs``    — compile-time presets (Mainnet/Minimal) + runtime ChainSpec
+                 (consensus/types/src/{eth_spec.rs,chain_spec.rs}).
+- ``ctypes_``  — consensus containers for every fork (consensus/types).
+- ``state_transition`` — the spec STF (consensus/state_processing).
+- ``fork_choice``      — LMD-GHOST proto-array (consensus/{fork_choice,proto_array}).
+- ``store``    — hot/cold DB (beacon_node/store).
+- ``chain``    — beacon chain core (beacon_node/beacon_chain).
+- ``parallel`` — device-mesh sharding of signature batches and merkle subtrees
+                 (the ICI analog of blst's multicore fan-out, SURVEY.md §5.8).
+- ``validator_client``, ``slasher``, ``api``, ``network`` — the parallel stacks.
+"""
+
+__version__ = "0.1.0"

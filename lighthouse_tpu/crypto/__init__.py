@@ -1,0 +1,7 @@
+"""Crypto layer (L0): BLS12-381, KZG, SHA-256, keystores.
+
+Equivalent of /root/reference/crypto/* with the backend-generic design of
+crypto/bls/src/lib.rs:86-141: every verification site funnels through
+``bls.verify_signature_sets`` so the whole client's signature load hits one
+batched choke point — which is exactly what maps onto TPU.
+"""

@@ -1,0 +1,10 @@
+"""TPU kernels (JAX/XLA; Pallas where hand-scheduling wins).
+
+- ``sha256``  — vmapped SHA-256 compression + merkle hash-tree kernels
+                (replaces the reference's asm `ethereum_hashing` + `tree_hash`,
+                SURVEY.md §2.1, for BeaconState merkleization on TPU).
+- ``bigint``  — limb-decomposed modular bignum arithmetic (batched, int32).
+- ``bls12_381`` — batched BLS12-381 field/curve/pairing kernels (replaces
+                `blst`'s multicore multi-pairing with TPU vector parallelism).
+- ``shuffle`` — vectorized swap-or-not shuffling.
+"""

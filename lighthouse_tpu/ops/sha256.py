@@ -1,0 +1,208 @@
+"""Batched SHA-256 + merkle hash-tree kernels for TPU.
+
+Replaces the reference's SHA-NI/asm `ethereum_hashing` and the `tree_hash` /
+`milhouse` merkleization stack (SURVEY.md §2.1; north star 2: <200 ms
+`BeaconState::tree_hash_root` at 1M validators, BASELINE.md).
+
+Design notes (TPU-first):
+- SHA-256 is pure 32-bit integer ALU work → it vectorizes across the *batch*
+  dimension on the VPU. All kernels below are "structure of arrays": a batch of
+  N hash states is a uint32[N, 8]; a batch of message blocks uint32[N, 16].
+- The 64 rounds are a statically unrolled trace — no data-dependent control
+  flow, so XLA fuses the whole compression into one kernel.
+- Merkle trees are dense, power-of-two padded with zero chunks (so padded
+  internal nodes equal the spec zero-subtree hashes), hashed level by level;
+  each level is one fused batched double-compression.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+_IV = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+#: Padding block for a 64-byte message: 0x80 then zeros then bit-length 512.
+_PAD64 = np.zeros(16, dtype=np.uint32)
+_PAD64[0] = 0x80000000
+_PAD64[15] = 512
+
+
+def _rotr(x, n):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def sha256_compress(state: jax.Array, block: jax.Array) -> jax.Array:
+    """One SHA-256 compression. state: u32[..., 8], block: u32[..., 16]."""
+    w = [block[..., i] for i in range(16)]
+    for i in range(16, 64):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> np.uint32(3))
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> np.uint32(10))
+        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+
+    a, b, c, d, e, f, g, h = (state[..., i] for i in range(8))
+    for i in range(64):
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + np.uint32(_K[i]) + w[i]
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = S0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+    return out + state
+
+
+@jax.jit
+def hash64(blocks: jax.Array) -> jax.Array:
+    """SHA-256 of 64-byte messages. blocks: u32[..., 16] -> u32[..., 8].
+
+    Two compressions: data block, then the constant length-padding block.
+    This is the merkle node combiner hash(left || right).
+    """
+    iv = jnp.broadcast_to(jnp.asarray(_IV), blocks.shape[:-1] + (8,))
+    mid = sha256_compress(iv, blocks)
+    pad = jnp.broadcast_to(jnp.asarray(_PAD64), blocks.shape[:-1] + (16,))
+    return sha256_compress(mid, pad)
+
+
+@jax.jit
+def hash_pairs(nodes: jax.Array) -> jax.Array:
+    """Merkle level step: u32[2N, 8] -> u32[N, 8] (hash of adjacent pairs)."""
+    n2 = nodes.shape[0]
+    blocks = nodes.reshape(n2 // 2, 16)
+    return hash64(blocks)
+
+
+def merkleize_dense(leaves: jax.Array, depth: int) -> jax.Array:
+    """Merkleize u32[2**depth, 8] chunk leaves into a root u32[8].
+
+    Python loop over levels, each a shape-specialized jitted batch
+    double-compression — small compile units, XLA caches per shape.
+    """
+    nodes = leaves
+    for _ in range(depth):
+        nodes = hash_pairs(nodes)
+    return nodes[0]
+
+
+@jax.jit
+def _fold_zero_caps(root: jax.Array, zeros: jax.Array) -> jax.Array:
+    """root u32[8], zeros u32[K, 8] -> fold hash64(root || zeros[i])."""
+    def step(r, z):
+        return hash64(jnp.concatenate([r, z])), None
+    out, _ = jax.lax.scan(step, root, zeros)
+    return out
+
+
+# -- host<->device chunk conversion -----------------------------------------
+
+def chunks_to_words(data: bytes | np.ndarray) -> np.ndarray:
+    """32-byte chunks -> u32[N, 8] big-endian words."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        arr = np.frombuffer(data, dtype=">u4")
+    else:
+        arr = data.view(">u4")
+    return arr.astype(np.uint32).reshape(-1, 8)
+
+
+def words_to_chunks(words: np.ndarray) -> bytes:
+    return np.asarray(words, dtype=np.uint32).astype(">u4").tobytes()
+
+
+def _zero_hash_words(max_depth: int = 64) -> np.ndarray:
+    from ..utils.hash import ZERO_HASHES
+    return np.stack([chunks_to_words(z)[0] for z in ZERO_HASHES[:max_depth]])
+
+
+ZERO_HASH_WORDS = _zero_hash_words()
+
+
+def _merkleize_capped(leaves: jax.Array, dense_depth: int,
+                      limit_depth: int) -> jax.Array:
+    root = merkleize_dense(leaves, dense_depth)
+    if dense_depth < limit_depth:
+        zeros = jnp.asarray(ZERO_HASH_WORDS[dense_depth:limit_depth])
+        root = _fold_zero_caps(root, zeros)
+    return root
+
+
+def merkleize_words(leaf_words: np.ndarray | jax.Array, limit: int) -> jax.Array:
+    """Merkleize N chunk-leaves (u32[N,8]) under a virtual tree of `limit`
+    leaves: dense-hash the padded live subtree, then fold in zero-subtree caps.
+    Returns the root as u32[8] on device.
+    """
+    n = int(leaf_words.shape[0])
+    limit_depth = max(0, (limit - 1).bit_length())
+    if n == 0:
+        return jnp.asarray(ZERO_HASH_WORDS[limit_depth])
+    dense = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    dense_depth = (dense - 1).bit_length()
+    leaves = jnp.asarray(leaf_words, dtype=jnp.uint32)
+    if dense != n:
+        pad = jnp.zeros((dense - n, 8), dtype=jnp.uint32)
+        leaves = jnp.concatenate([leaves, pad], axis=0)
+    return _merkleize_capped(leaves, dense_depth, limit_depth)
+
+
+@jax.jit
+def _mix_in_words(root: jax.Array, length_words: jax.Array) -> jax.Array:
+    return hash64(jnp.concatenate([root, length_words]))
+
+
+def mix_in_length_words(root: jax.Array, length: int) -> jax.Array:
+    length_words = chunks_to_words(int(length).to_bytes(32, "little"))[0]
+    return _mix_in_words(root, jnp.asarray(length_words))
+
+
+# -- multi-block message hashing (general sha256 on device) ------------------
+
+@jax.jit
+def sha256_messages(msgs: jax.Array) -> jax.Array:
+    """SHA-256 of a batch of equal-length padded messages.
+
+    msgs: u32[N, B, 16] — already padded per FIPS-180-4 into B blocks.
+    """
+    n, nblocks, _ = msgs.shape
+    state = jnp.broadcast_to(jnp.asarray(_IV), (n, 8))
+    for b in range(nblocks):
+        state = sha256_compress(state, msgs[:, b, :])
+    return state
+
+
+def pad_messages(msgs: np.ndarray) -> np.ndarray:
+    """Pad a batch of equal-length byte messages u8[N, L] to u32[N, B, 16]."""
+    n, length = msgs.shape
+    bit_len = length * 8
+    total = ((length + 9 + 63) // 64) * 64
+    out = np.zeros((n, total), dtype=np.uint8)
+    out[:, :length] = msgs
+    out[:, length] = 0x80
+    out[:, -8:] = np.frombuffer(
+        np.uint64(bit_len).byteswap().tobytes(), dtype=np.uint8)
+    words = out.reshape(n, total // 64, 16, 4).view(">u4")[..., 0]
+    return words.astype(np.uint32)

@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic
+(jax.sharding.Mesh / shard_map) is exercised without TPU hardware, mirroring how
+the reference tests multi-node behavior in one process
+(/root/reference/testing/simulator/src/local_network.rs:107).
+Benchmarks (bench.py) run on the real TPU chip instead.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
