@@ -134,6 +134,10 @@ def words_to_chunks(words: np.ndarray) -> bytes:
     return np.asarray(words, dtype=np.uint32).astype(">u4").tobytes()
 
 
+def jnp_asarray(x) -> jax.Array:
+    return jnp.asarray(x, dtype=jnp.uint32)
+
+
 def _zero_hash_words(max_depth: int = 64) -> np.ndarray:
     from ..utils.hash import ZERO_HASHES
     return np.stack([chunks_to_words(z)[0] for z in ZERO_HASHES[:max_depth]])

@@ -1,0 +1,185 @@
+"""Container + SoA BeaconState tests.
+
+The SoA BeaconState's custom serialize/hash_tree_root is checked against a
+generic SSZ container built mechanically from the same field schema — a
+bit-exact oracle covering every field kind.
+"""
+import numpy as np
+import pytest
+
+from lighthouse_tpu.containers import BeaconState, ValidatorRegistry, get_types
+from lighthouse_tpu.containers.state import (
+    active_field_specs, new_state,
+)
+from lighthouse_tpu.specs import ForkName, minimal_spec
+from lighthouse_tpu.ssz import (
+    List, Root, Vector, container, hash_tree_root, htr, serialize,
+    uint8, uint64,
+)
+
+SPEC = minimal_spec(altair_fork_epoch=2, bellatrix_fork_epoch=4,
+                    capella_fork_epoch=6, deneb_fork_epoch=8,
+                    electra_fork_epoch=10)
+T = get_types(SPEC.preset)
+
+
+def _generic_state_type(T, fork):
+    """Build the equivalent plain-SSZ container for the fork's schema."""
+    ann = {}
+    for f in active_field_specs(T, fork):
+        if f.kind in ("ssz", "payload_header"):
+            ann[f.name] = (f.typ if f.kind == "ssz"
+                           else T.ExecutionPayloadHeader[max(fork, ForkName.BELLATRIX)].ssz_type)
+        elif f.kind == "ssz_list":
+            ann[f.name] = List(f.typ, f.limit)
+        elif f.kind == "roots_vec":
+            ann[f.name] = Vector(Root, f.limit)
+        elif f.kind == "roots_list":
+            ann[f.name] = List(Root, f.limit)
+        elif f.kind == "u64_vec":
+            ann[f.name] = Vector(uint64, f.limit)
+        elif f.kind == "u64_list":
+            ann[f.name] = List(uint64, f.limit)
+        elif f.kind == "u8_list":
+            ann[f.name] = List(uint8, f.limit)
+        elif f.kind == "validators":
+            ann[f.name] = List(T.Validator.ssz_type, f.limit)
+    return container(type(f"GenericState{fork.name}", (),
+                          {"__annotations__": ann}))
+
+
+def _fill_state(fork):
+    rng = np.random.default_rng(42)
+    st = new_state(SPEC, fork)
+    st.genesis_time = 12345
+    st.genesis_validators_root = b"\x99" * 32
+    st.slot = 17
+    st.fork = T.Fork(previous_version=b"\x00" * 4,
+                     current_version=b"\x01\x00\x00\x01", epoch=2)
+    st.latest_block_header = T.BeaconBlockHeader(slot=16, proposer_index=3,
+                                                 parent_root=b"\x01" * 32,
+                                                 state_root=b"\x02" * 32,
+                                                 body_root=b"\x03" * 32)
+    st.block_roots = rng.integers(0, 256, st.block_roots.shape, np.uint8)
+    st.state_roots = rng.integers(0, 256, st.state_roots.shape, np.uint8)
+    st.historical_roots = [b"\x07" * 32, b"\x08" * 32]
+    st.eth1_data = T.Eth1Data(deposit_root=b"\x0a" * 32, deposit_count=5,
+                              block_hash=b"\x0b" * 32)
+    st.eth1_data_votes = [st.eth1_data]
+    st.eth1_deposit_index = 5
+    for i in range(5):
+        st.validators.append(bytes([i]) * 48, bytes([i + 1]) * 32,
+                             32 * 10**9, i == 2, 0, 0, 2**64 - 1, 2**64 - 1)
+    st.balances = np.asarray([32 * 10**9 + i for i in range(5)], np.uint64)
+    st.randao_mixes = rng.integers(0, 256, st.randao_mixes.shape, np.uint8)
+    st.slashings[3] = 7 * 10**9
+    st.justification_bits = [True, False, True, False]
+    st.previous_justified_checkpoint = T.Checkpoint(epoch=1, root=b"\x0c" * 32)
+    st.current_justified_checkpoint = T.Checkpoint(epoch=2, root=b"\x0d" * 32)
+    st.finalized_checkpoint = T.Checkpoint(epoch=1, root=b"\x0e" * 32)
+    if fork == ForkName.PHASE0:
+        att_data = T.AttestationData(
+            slot=3, index=0, beacon_block_root=b"\x11" * 32,
+            source=T.Checkpoint(), target=T.Checkpoint())
+        st.previous_epoch_attestations = [
+            T.PendingAttestation(aggregation_bits=[True, False, True],
+                                 data=att_data, inclusion_delay=1,
+                                 proposer_index=2)]
+    if fork >= ForkName.ALTAIR:
+        st.previous_epoch_participation = np.asarray([1, 3, 7, 0, 2], np.uint8)
+        st.current_epoch_participation = np.asarray([0, 1, 0, 5, 0], np.uint8)
+        st.inactivity_scores = np.asarray([0, 4, 0, 0, 8], np.uint64)
+        pks = [bytes([i]) * 48 for i in range(T.preset.sync_committee_size)]
+        st.current_sync_committee = T.SyncCommittee(
+            pubkeys=pks, aggregate_pubkey=b"\x2a" * 48)
+        st.next_sync_committee = T.SyncCommittee(
+            pubkeys=pks, aggregate_pubkey=b"\x2b" * 48)
+    if fork >= ForkName.BELLATRIX:
+        st.latest_execution_payload_header = \
+            T.ExecutionPayloadHeader[max(fork, ForkName.BELLATRIX)](
+                block_number=9, extra_data=b"\xee\xff",
+                base_fee_per_gas=10**9, transactions_root=b"\x31" * 32)
+    if fork >= ForkName.CAPELLA:
+        st.next_withdrawal_index = 4
+        st.next_withdrawal_validator_index = 1
+        st.historical_summaries = [T.HistoricalSummary(
+            block_summary_root=b"\x41" * 32, state_summary_root=b"\x42" * 32)]
+    if fork >= ForkName.ELECTRA:
+        st.deposit_balance_to_consume = 11
+        st.pending_deposits = [T.PendingDeposit(pubkey=b"\x51" * 48,
+                                                withdrawal_credentials=b"\x52" * 32,
+                                                amount=10**9,
+                                                signature=b"\x53" * 96,
+                                                slot=3)]
+        st.pending_consolidations = [T.PendingConsolidation(source_index=1,
+                                                            target_index=2)]
+    return st
+
+
+def _to_generic(st, fork, gen_cls):
+    kw = {}
+    for f in active_field_specs(T, fork):
+        v = getattr(st, f.name)
+        if f.kind == "roots_vec":
+            kw[f.name] = [v[i].tobytes() for i in range(v.shape[0])]
+        elif f.kind in ("u64_vec", "u64_list", "u8_list"):
+            kw[f.name] = [int(x) for x in v]
+        elif f.kind == "validators":
+            kw[f.name] = [T.Validator(
+                pubkey=w.pubkey, withdrawal_credentials=w.withdrawal_credentials,
+                effective_balance=w.effective_balance, slashed=w.slashed,
+                activation_eligibility_epoch=w.activation_eligibility_epoch,
+                activation_epoch=w.activation_epoch, exit_epoch=w.exit_epoch,
+                withdrawable_epoch=w.withdrawable_epoch) for w in v]
+        else:
+            kw[f.name] = v
+    return gen_cls(**kw)
+
+
+@pytest.mark.parametrize("fork", [ForkName.PHASE0, ForkName.ALTAIR,
+                                  ForkName.CAPELLA, ForkName.ELECTRA])
+def test_state_matches_generic_ssz(fork):
+    st = _fill_state(fork)
+    gen_cls = _generic_state_type(T, fork)
+    gen = _to_generic(st, fork, gen_cls)
+    assert st.serialize() == serialize(gen_cls.ssz_type, gen)
+    assert st.hash_tree_root() == htr(gen)
+    # roundtrip
+    back = BeaconState.from_ssz_bytes(st.serialize(), T, SPEC, fork)
+    assert back.serialize() == st.serialize()
+    assert back.hash_tree_root() == st.hash_tree_root()
+
+
+def test_state_copy_isolation():
+    st = _fill_state(ForkName.ALTAIR)
+    c = st.copy()
+    c.balances[0] = 1
+    c.validators.set_field(1, "exit_epoch", 9)
+    c.slot = 99
+    assert st.balances[0] != 1
+    assert st.validators.view(1).exit_epoch == 2**64 - 1
+    assert st.slot == 17
+    # roots diverge after mutation
+    assert c.hash_tree_root() != st.hash_tree_root()
+
+
+def test_block_container_roundtrip():
+    blk_cls = T.BeaconBlock[ForkName.PHASE0]
+    body_cls = T.BeaconBlockBody[ForkName.PHASE0]
+    blk = blk_cls(slot=1, proposer_index=2, parent_root=b"\x01" * 32,
+                  state_root=b"\x02" * 32, body=body_cls(
+                      randao_reveal=b"\x05" * 96, graffiti=b"\x06" * 32))
+    t = blk_cls.ssz_type
+    from lighthouse_tpu.ssz import deserialize
+    assert deserialize(t, serialize(t, blk)) == blk
+    assert len(htr(blk)) == 32
+
+
+def test_validator_registry_htr_cache():
+    vr = ValidatorRegistry()
+    vr.append(b"\x01" * 48, b"\x02" * 32, 32 * 10**9, False, 0, 0,
+              2**64 - 1, 2**64 - 1)
+    r1 = vr.hash_tree_root(2**40)
+    assert vr.hash_tree_root(2**40) == r1  # cached
+    vr.set_field(0, "effective_balance", 31 * 10**9)
+    assert vr.hash_tree_root(2**40) != r1  # dirty invalidation
