@@ -103,6 +103,18 @@ class ValidatorRegistry:
     def mark_dirty(self) -> None:
         self._dirty = True
 
+    def index_of(self, pubkey: bytes) -> int | None:
+        """Pubkey -> validator index (the ValidatorPubkeyCache analog,
+        beacon_chain/src/validator_pubkey_cache.rs:20)."""
+        cache = getattr(self, "_pk_index", None)
+        if cache is None or len(cache) != len(self):
+            cache = {self.pubkeys[i].tobytes(): i for i in range(len(self))}
+            self._pk_index = cache
+        return cache.get(pubkey)
+
+    def pubkey(self, i: int) -> bytes:
+        return self.pubkeys[i].tobytes()
+
     def view(self, i: int) -> ValidatorView:
         return ValidatorView(
             pubkey=self.pubkeys[i].tobytes(),

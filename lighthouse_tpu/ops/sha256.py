@@ -57,23 +57,42 @@ def _rotr(x, n):
 
 
 def sha256_compress(state: jax.Array, block: jax.Array) -> jax.Array:
-    """One SHA-256 compression. state: u32[..., 8], block: u32[..., 16]."""
-    w = [block[..., i] for i in range(16)]
-    for i in range(16, 64):
-        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> np.uint32(3))
-        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> np.uint32(10))
-        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+    """One SHA-256 compression. state: u32[..., 8], block: u32[..., 16].
 
-    a, b, c, d, e, f, g, h = (state[..., i] for i in range(8))
-    for i in range(64):
+    The 64 rounds are `lax.scan`s (not unrolled): the compiled graph stays
+    ~50 ops regardless of batch shape — fast XLA compiles (the unrolled form
+    sent the CPU backend's algebraic simplifier into minutes-long loops) and
+    identical steady-state throughput, since rounds are sequential anyway and
+    the batch dimension stays fully vectorized inside each iteration.
+    """
+    # message schedule: W[64, ...] via a rolling 16-word window
+    w_first = jnp.moveaxis(block, -1, 0)  # [16, ...]
+
+    def sched_step(window, _):
+        w15 = window[1]
+        w2 = window[14]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+        wt = window[0] + s0 + window[9] + s1
+        return jnp.concatenate([window[1:], wt[None]], axis=0), wt
+
+    _, w_rest = jax.lax.scan(sched_step, w_first, None, length=48)
+    W = jnp.concatenate([w_first, w_rest], axis=0)  # [64, ...]
+
+    def round_step(carry, kw):
+        a, b, c, d, e, f, g, h = carry
+        k, w = kw
         S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + S1 + ch + np.uint32(_K[i]) + w[i]
+        t1 = h + S1 + ch + k + w
         S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = S0 + maj
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
-    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[..., i] for i in range(8))
+    final, _ = jax.lax.scan(round_step, init, (jnp.asarray(_K), W))
+    out = jnp.stack(final, axis=-1)
     return out + state
 
 

@@ -1,0 +1,73 @@
+"""Swap-or-not shuffle, vectorized.
+
+Equivalent of /root/reference/consensus/swap_or_not_shuffle/src/shuffle_list.rs
+(whole-list shuffle, :1-40). The reference walks the list imperatively; here
+every round transforms the entire index vector at once with numpy, and the
+per-round randomness (SHA-256 of seed||round||block) is batched — the same
+shape the TPU shuffle kernel uses (ops/shuffle wiring planned).
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def _round_pivot(seed: bytes, r: int, n: int) -> int:
+    h = hashlib.sha256(seed + bytes([r])).digest()
+    return int.from_bytes(h[:8], "little") % n
+
+
+def _round_source_bits(seed: bytes, r: int, n: int) -> np.ndarray:
+    """All randomness bits for a round: bit array of length >= n."""
+    num_blocks = (n + 255) // 256
+    blocks = bytearray()
+    for block in range(num_blocks):
+        blocks += hashlib.sha256(
+            seed + bytes([r]) + block.to_bytes(4, "little")).digest()
+    byts = np.frombuffer(bytes(blocks), dtype=np.uint8)
+    return np.unpackbits(byts, bitorder="little")
+
+
+def compute_shuffled_indices(n: int, seed: bytes,
+                             rounds: int) -> np.ndarray:
+    """Vector of sigma(i) for i in 0..n: position -> source index.
+
+    shuffled_list[i] == input[out[i]] reproduces the spec's
+    compute_shuffled_index applied index-wise (forward direction).
+    """
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    idx = np.arange(n, dtype=np.int64)
+    # the scalar spec transform, applied to every index at once, round by round
+    for r in range(rounds):
+        pivot = _round_pivot(seed, r, n)
+        flip = (pivot - idx) % n
+        pos = np.maximum(idx, flip)
+        bits = _round_source_bits(seed, r, n)
+        idx = np.where(bits[pos] == 1, flip, idx)
+    return idx
+
+
+def compute_shuffled_index(index: int, n: int, seed: bytes,
+                           rounds: int) -> int:
+    """Spec-exact scalar compute_shuffled_index (forward)."""
+    assert 0 <= index < n
+    for r in range(rounds):
+        pivot = _round_pivot(seed, r, n)
+        flip = (pivot + n - index) % n
+        position = max(index, flip)
+        source = hashlib.sha256(
+            seed + bytes([r]) + (position // 256).to_bytes(4, "little")
+        ).digest()
+        byte = source[(position % 256) // 8]
+        bit = (byte >> (position % 8)) & 1
+        index = flip if bit else index
+    return index
+
+
+def shuffle_list(values: np.ndarray, seed: bytes, rounds: int) -> np.ndarray:
+    """Shuffled copy with spec orientation: out[i] = values[sigma(i)], so
+    committees are contiguous slices of the output (compute_committee)."""
+    sigma = compute_shuffled_indices(len(values), seed, rounds)
+    return values[sigma]

@@ -1,0 +1,7 @@
+"""Test utilities: state harness, deterministic keys, mock services.
+
+Equivalent of the reference's test infrastructure (SURVEY.md §4):
+BeaconChainHarness (beacon_chain/src/test_utils.rs:611), deterministic
+interop keypairs, TestingSlotClock, MockExecutionLayer.
+"""
+from .state_harness import StateHarness

@@ -10,6 +10,12 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# NOTE: the axon TPU plugin claims the (single) chip at *interpreter startup*
+# via sitecustomize when PALLAS_AXON_POOL_IPS is set — too early for this
+# conftest to stop it. Run tests with the claim disabled:
+#   env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/root/repo/.jax_cache")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

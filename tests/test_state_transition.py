@@ -1,0 +1,100 @@
+"""End-to-end STF tests on the minimal preset with fake crypto — the
+reference's dominant test mode (EF tests run twice, once with fake_crypto;
+chain tests use it throughout, SURVEY.md §4)."""
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import ForkName, minimal_spec
+from lighthouse_tpu.ssz import htr
+from lighthouse_tpu.state_transition import (
+    interop_genesis_state, is_valid_genesis_state, per_block_processing,
+    process_slots,
+)
+from lighthouse_tpu.state_transition.block import (
+    BlockProcessingError, VerifySignatures,
+)
+from lighthouse_tpu.testing import StateHarness
+
+VALIDATORS = 64
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def test_interop_genesis():
+    spec = minimal_spec()
+    h = StateHarness(spec, VALIDATORS)
+    st = h.state
+    assert len(st.validators) == VALIDATORS
+    assert is_valid_genesis_state(st)
+    assert int(st.balances[0]) == spec.preset.max_effective_balance
+    assert st.validators.view(0).activation_epoch == 0
+    assert st.genesis_validators_root != b"\x00" * 32
+
+
+def test_empty_slots_cross_epoch():
+    spec = minimal_spec()
+    h = StateHarness(spec, VALIDATORS)
+    process_slots(h.state, spec.preset.slots_per_epoch * 2 + 1)
+    assert h.state.current_epoch() == 2
+    # no attestations -> no justification
+    assert h.state.current_justified_checkpoint.epoch == 0
+
+
+def test_chain_finalizes_phase0():
+    spec = minimal_spec()
+    h = StateHarness(spec, VALIDATORS)
+    # 5 epochs of full participation
+    h.extend_chain(5 * spec.preset.slots_per_epoch)
+    st = h.state
+    assert st.current_justified_checkpoint.epoch >= 3
+    assert st.finalized_checkpoint.epoch >= 2, (
+        st.current_justified_checkpoint, st.finalized_checkpoint)
+
+
+def test_chain_finalizes_altair():
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = StateHarness(spec, VALIDATORS)
+    assert h.state.fork_name == ForkName.ALTAIR
+    h.extend_chain(5 * spec.preset.slots_per_epoch)
+    assert h.state.finalized_checkpoint.epoch >= 2
+    # participation flags rotated and balances moved
+    assert int(h.state.balances.sum()) != \
+        VALIDATORS * spec.preset.max_effective_balance
+
+
+def test_fork_upgrade_mid_chain():
+    spec = minimal_spec(altair_fork_epoch=1, bellatrix_fork_epoch=2,
+                        capella_fork_epoch=3)
+    h = StateHarness(spec, VALIDATORS)
+    h.extend_chain(4 * spec.preset.slots_per_epoch)
+    assert h.state.fork_name == ForkName.CAPELLA
+    assert h.state.fork.current_version == spec.capella_fork_version
+    assert h.state.latest_execution_payload_header is not None
+    assert h.state.next_withdrawal_index is not None
+
+
+def test_bad_proposer_rejected():
+    spec = minimal_spec()
+    h = StateHarness(spec, VALIDATORS)
+    signed, _post = h.produce_block_on_state(h.state.copy(), 1)
+    # tamper with proposer index
+    blk = signed.message
+    blk.proposer_index = (blk.proposer_index + 1) % VALIDATORS
+    st = h.state.copy()
+    process_slots(st, 1)
+    with pytest.raises(BlockProcessingError):
+        per_block_processing(st, signed, VerifySignatures.FALSE)
+
+
+def test_block_replay_reproduces_state():
+    from lighthouse_tpu.state_transition import BlockReplayer
+    spec = minimal_spec()
+    h = StateHarness(spec, VALIDATORS)
+    genesis = h.genesis_state.copy()
+    blocks = h.extend_chain(10)
+    replayed = BlockReplayer(genesis).apply_blocks(blocks)
+    assert replayed.hash_tree_root() == h.state.hash_tree_root()
