@@ -88,6 +88,19 @@ def decrease_balance(state: BeaconState, index: int, delta: int) -> None:
     state.balances[index] = 0 if delta > cur else cur - delta
 
 
+def latest_block_header_root(state: BeaconState) -> bytes:
+    """Root of the latest block, filling in the state root if not yet set
+    (it is zeroed by process_block_header until the next process_slot)."""
+    from ..ssz import htr
+    hdr = state.latest_block_header
+    if hdr.state_root == b"\x00" * 32:
+        hdr = state.T.BeaconBlockHeader(
+            slot=hdr.slot, proposer_index=hdr.proposer_index,
+            parent_root=hdr.parent_root, state_root=state.hash_tree_root(),
+            body_root=hdr.body_root)
+    return htr(hdr)
+
+
 # -- randomness / seeds ------------------------------------------------------
 
 def get_seed(state: BeaconState, epoch: int, domain_type: int) -> bytes:

@@ -1,0 +1,9 @@
+"""Storage layer (L5): hot/cold split database.
+
+Equivalent of /root/reference/beacon_node/store: `KeyValueStore` trait
+(src/lib.rs:53), `HotColdDB` (src/hot_cold_store.rs:50), `MemoryStore`,
+LevelDB backend (here: the C++ kvstore in native/, via ctypes), state
+reconstruction by block replay (src/reconstruct.rs).
+"""
+from .kv import KeyValueStore, MemoryStore, NativeKvStore, StoreError
+from .hot_cold import HotColdDB, Split, StoreConfig

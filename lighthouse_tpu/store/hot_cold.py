@@ -1,0 +1,310 @@
+"""Hot/cold split database.
+
+Equivalent of /root/reference/beacon_node/store/src/hot_cold_store.rs:50:
+- hot DB: all unfinalized blocks; full states at epoch boundaries; per-slot
+  `HotStateSummary`s pointing at their epoch-boundary state; states rebuilt
+  by block replay (BlockReplayer, reconstruct.rs).
+- freezer ("cold") DB: finalized block roots by slot + sparse restore-point
+  states every `slots_per_restore_point`.
+- `Split` marks the hot/cold boundary (hot_cold_store.rs:2715); `migrate`
+  moves finalized data across it and prunes abandoned forks.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from ..containers import get_types
+from ..containers.state import BeaconState
+from ..specs.chain_spec import ChainSpec, ForkName
+from ..ssz import deserialize, htr, serialize
+from .kv import KeyValueStore, StoreError
+
+# column prefixes
+BLOCK = b"b:"
+HOT_STATE_FULL = b"S:"
+HOT_STATE_SUMMARY = b"s:"
+FREEZER_BLOCK_ROOT = b"fbr:"   # slot (be64) -> block root
+FREEZER_STATE = b"fst:"        # slot (be64) -> full state
+BLOBS = b"o:"
+METADATA = b"m:"
+ITEM = b"i:"                   # generic persisted items (fork choice, op pool)
+
+SCHEMA_VERSION = 1
+
+
+@dataclass
+class Split:
+    slot: int = 0
+    state_root: bytes = b"\x00" * 32
+
+
+@dataclass
+class StoreConfig:
+    slots_per_restore_point: int = 2048
+    compact_on_prune: bool = True
+
+
+class HotColdDB:
+    def __init__(self, hot: KeyValueStore, cold: KeyValueStore,
+                 spec: ChainSpec, config: StoreConfig | None = None):
+        self.hot = hot
+        self.cold = cold
+        self.spec = spec
+        self.T = get_types(spec.preset)
+        self.config = config or StoreConfig()
+        self.split = self._load_split()
+        self._put_meta(b"schema", struct.pack("<I", SCHEMA_VERSION))
+
+    # -- metadata ------------------------------------------------------------
+
+    def _put_meta(self, key: bytes, value: bytes) -> None:
+        self.hot.put(METADATA + key, value)
+
+    def _get_meta(self, key: bytes) -> bytes | None:
+        return self.hot.get(METADATA + key)
+
+    def _load_split(self) -> Split:
+        raw = self._get_meta(b"split")
+        if raw is None:
+            return Split()
+        slot, root = struct.unpack("<Q", raw[:8])[0], raw[8:40]
+        return Split(slot, root)
+
+    def _store_split(self) -> None:
+        self._put_meta(b"split",
+                       struct.pack("<Q", self.split.slot)
+                       + self.split.state_root)
+
+    def schema_version(self) -> int:
+        raw = self._get_meta(b"schema")
+        return struct.unpack("<I", raw)[0] if raw else 0
+
+    def put_item(self, key: bytes, value: bytes) -> None:
+        self.hot.put(ITEM + key, value)
+
+    def get_item(self, key: bytes) -> bytes | None:
+        return self.hot.get(ITEM + key)
+
+    # -- blocks --------------------------------------------------------------
+
+    def put_block(self, block_root: bytes, signed_block) -> None:
+        fork = signed_block.fork_name
+        data = bytes([fork.value]) + serialize(
+            type(signed_block).ssz_type, signed_block)
+        self.hot.put(BLOCK + block_root, data)
+
+    def get_block(self, block_root: bytes):
+        raw = self.hot.get(BLOCK + block_root)
+        if raw is None:
+            return None
+        fork = ForkName(raw[0])
+        cls = self.T.SignedBeaconBlock[fork]
+        return deserialize(cls.ssz_type, raw[1:])
+
+    def block_exists(self, block_root: bytes) -> bool:
+        return self.hot.exists(BLOCK + block_root)
+
+    def delete_block(self, block_root: bytes) -> None:
+        self.hot.delete(BLOCK + block_root)
+
+    # -- blobs ---------------------------------------------------------------
+
+    def put_blobs(self, block_root: bytes, blobs: list) -> None:
+        from ..ssz import List as SSZList
+        t = SSZList(self.T.BlobSidecar.ssz_type,
+                    self.T.preset.max_blob_commitments_per_block)
+        self.hot.put(BLOBS + block_root, serialize(t, blobs))
+
+    def get_blobs(self, block_root: bytes) -> list | None:
+        from ..ssz import List as SSZList
+        raw = self.hot.get(BLOBS + block_root)
+        if raw is None:
+            return None
+        t = SSZList(self.T.BlobSidecar.ssz_type,
+                    self.T.preset.max_blob_commitments_per_block)
+        return deserialize(t, raw)
+
+    # -- hot states ----------------------------------------------------------
+
+    def put_state(self, state_root: bytes, state: BeaconState) -> None:
+        p = self.T.preset
+        if state.slot % p.slots_per_epoch == 0:
+            data = bytes([state.fork_name.value]) + state.serialize()
+            self.hot.put(HOT_STATE_FULL + state_root, data)
+        latest_block_root = self._latest_block_root(state)
+        boundary_slot = (state.slot // p.slots_per_epoch) * p.slots_per_epoch
+        boundary_root = (state_root if state.slot == boundary_slot
+                         else state.state_roots[
+                             boundary_slot % p.slots_per_historical_root
+                         ].tobytes())
+        summary = struct.pack("<Q", state.slot) + latest_block_root \
+            + boundary_root
+        self.hot.put(HOT_STATE_SUMMARY + state_root, summary)
+
+    @staticmethod
+    def _latest_block_root(state: BeaconState) -> bytes:
+        from ..state_transition.helpers import latest_block_header_root
+        return latest_block_header_root(state)
+
+    def get_hot_state(self, state_root: bytes) -> BeaconState | None:
+        raw = self.hot.get(HOT_STATE_FULL + state_root)
+        if raw is not None:
+            fork = ForkName(raw[0])
+            return BeaconState.from_ssz_bytes(raw[1:], self.T, self.spec,
+                                              fork)
+        summary = self.hot.get(HOT_STATE_SUMMARY + state_root)
+        if summary is None:
+            return None
+        slot = struct.unpack("<Q", summary[:8])[0]
+        latest_block_root = summary[8:40]
+        boundary_root = summary[40:72]
+        boundary_raw = self.hot.get(HOT_STATE_FULL + boundary_root)
+        if boundary_raw is None:
+            raise StoreError("missing epoch boundary state")
+        state = BeaconState.from_ssz_bytes(
+            boundary_raw[1:], self.T, self.spec, ForkName(boundary_raw[0]))
+        # collect blocks (boundary, slot] by walking back from the summary's
+        # latest block
+        blocks = []
+        root = latest_block_root
+        while True:
+            blk = self.get_block(root)
+            if blk is None or blk.message.slot <= state.slot:
+                break
+            blocks.append(blk)
+            root = blk.message.parent_root
+        blocks.reverse()
+        from ..state_transition import BlockReplayer
+        return BlockReplayer(state).apply_blocks(blocks, target_slot=slot)
+
+    def get_state(self, state_root: bytes,
+                  slot: int | None = None) -> BeaconState | None:
+        st = self.get_hot_state(state_root)
+        if st is not None:
+            return st
+        if slot is not None:
+            return self.load_cold_state_by_slot(slot)
+        return None
+
+    def delete_state(self, state_root: bytes) -> None:
+        self.hot.delete(HOT_STATE_FULL + state_root)
+        self.hot.delete(HOT_STATE_SUMMARY + state_root)
+
+    def store_genesis(self, genesis_block_root: bytes,
+                      genesis_state: BeaconState) -> None:
+        """Anchor the DB: genesis state goes to both hot and freezer (the
+        slot-0 restore point every cold reconstruction bottoms out on)."""
+        root = genesis_state.hash_tree_root()
+        self.put_state(root, genesis_state)
+        self.freezer_put_state(genesis_state.slot, genesis_state)
+        self.freezer_put_block_root(genesis_state.slot, genesis_block_root)
+        self._put_meta(b"genesis_block_root", genesis_block_root)
+
+    def genesis_block_root(self) -> bytes | None:
+        return self._get_meta(b"genesis_block_root")
+
+    # -- freezer -------------------------------------------------------------
+
+    def freezer_put_block_root(self, slot: int, block_root: bytes) -> None:
+        self.cold.put(FREEZER_BLOCK_ROOT + struct.pack(">Q", slot),
+                      block_root)
+
+    def freezer_block_root_at_slot(self, slot: int) -> bytes | None:
+        return self.cold.get(FREEZER_BLOCK_ROOT + struct.pack(">Q", slot))
+
+    def freezer_put_state(self, slot: int, state: BeaconState) -> None:
+        data = bytes([state.fork_name.value]) + state.serialize()
+        self.cold.put(FREEZER_STATE + struct.pack(">Q", slot), data)
+
+    def load_cold_state_by_slot(self, slot: int) -> BeaconState | None:
+        """Load the nearest restore point at/below `slot` and replay."""
+        srp = self.config.slots_per_restore_point
+        rp_slot = (slot // srp) * srp
+        raw = None
+        while rp_slot >= 0:
+            raw = self.cold.get(FREEZER_STATE + struct.pack(">Q", rp_slot))
+            if raw is not None:
+                break
+            if rp_slot == 0:
+                break
+            rp_slot -= srp
+        if raw is None:
+            return None
+        state = BeaconState.from_ssz_bytes(raw[1:], self.T, self.spec,
+                                           ForkName(raw[0]))
+        if state.slot == slot:
+            return state
+        blocks = []
+        seen = None
+        for s in range(state.slot + 1, slot + 1):
+            root = self.freezer_block_root_at_slot(s)
+            if root is None or root == seen:
+                continue  # skipped slot (same root repeated)
+            seen = root
+            blk = self.get_block(root)
+            if blk is not None and blk.message.slot > state.slot:
+                blocks.append(blk)
+        from ..state_transition import BlockReplayer
+        return BlockReplayer(state).apply_blocks(blocks, target_slot=slot)
+
+    # -- migration (freezing) ------------------------------------------------
+
+    def migrate_database(self, finalized_slot: int,
+                         finalized_state_root: bytes,
+                         finalized_block_root: bytes,
+                         canonical_roots: dict[int, bytes],
+                         abandoned_block_roots: list[bytes] = (),
+                         abandoned_state_roots: list[bytes] = ()) -> None:
+        """Advance the split: record canonical block roots in the freezer,
+        store restore points, prune abandoned forks and hot states below the
+        split (store/src/migrate.rs + hot_cold_store.rs migration)."""
+        if finalized_slot <= self.split.slot:
+            return
+        srp = self.config.slots_per_restore_point
+        for slot in range(self.split.slot, finalized_slot + 1):
+            root = canonical_roots.get(slot)
+            if root is not None:
+                self.freezer_put_block_root(slot, root)
+        # restore points
+        for slot in range(self.split.slot, finalized_slot + 1):
+            if slot % srp == 0:
+                root = canonical_roots.get(slot)
+                # summaries map state roots; load via hot state if available
+                st = None
+                if root is not None:
+                    blk = self.get_block(root)
+                    if blk is not None:
+                        st = self.get_hot_state(blk.message.state_root)
+                if st is not None:
+                    self.freezer_put_state(slot, st)
+        # prune abandoned forks
+        for root in abandoned_block_roots:
+            self.delete_block(root)
+        for root in abandoned_state_roots:
+            self.delete_state(root)
+        # drop hot states strictly below the new split (keep the finalized one)
+        for key, summary in list(self.hot.iter_prefix(HOT_STATE_SUMMARY)):
+            slot = struct.unpack("<Q", summary[:8])[0]
+            state_root = key[len(HOT_STATE_SUMMARY):]
+            if slot < finalized_slot and state_root != finalized_state_root:
+                self.delete_state(state_root)
+        self.split = Split(finalized_slot, finalized_state_root)
+        self._store_split()
+        self.hot.sync()
+        self.cold.sync()
+
+    # -- iteration -----------------------------------------------------------
+
+    def iter_block_roots_back(self, head_root: bytes):
+        """Walk (root, slot) back through parent links (forwards_iter.rs /
+        iter.rs equivalent, hot side)."""
+        root = head_root
+        while True:
+            blk = self.get_block(root)
+            if blk is None:
+                return
+            yield root, blk.message.slot
+            if blk.message.slot == 0:
+                return
+            root = blk.message.parent_root

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build the native host runtime libraries.
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -std=c++17 -shared -fPIC -o libkvstore.so kvstore.cpp
+if [ -f sha256_host.cpp ]; then
+  g++ -O3 -std=c++17 -march=native -shared -fPIC -o libsha256host.so sha256_host.cpp
+fi
+echo "built: $(ls *.so)"

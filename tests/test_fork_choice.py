@@ -1,0 +1,151 @@
+"""Fork choice tests: proto-array mechanics + spec wrapper behavior.
+
+Mirrors the in-crate test style of consensus/proto_array (vote application,
+tie-breaking, pruning, invalidation) without EF vectors.
+"""
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.fork_choice import (
+    ExecutionStatus, ForkChoice, ProtoArray, ProtoNode, VoteTracker,
+    compute_deltas,
+)
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.ssz import htr
+from lighthouse_tpu.testing import StateHarness
+
+
+def _root(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def _node(slot, root, parent, jc=(0, _root(0)), fc=(0, _root(0))):
+    return ProtoNode(slot=slot, root=root, parent=parent,
+                     state_root=b"\x00" * 32, target_root=root,
+                     justified_checkpoint=jc, finalized_checkpoint=fc)
+
+
+def test_proto_array_head_follows_weight():
+    pa = ProtoArray((0, _root(0)), (0, _root(0)))
+    pa.on_block(_node(0, _root(0), None))
+    pa.on_block(_node(1, _root(1), 0))
+    pa.on_block(_node(1, _root(2), 0))  # fork at slot 1
+    # no votes: tie broken by root (root(2) > root(1))
+    pa.apply_score_changes({}, (0, _root(0)), (0, _root(0)),
+                           (b"\x00" * 32, 0))
+    assert pa.find_head(_root(0)) == _root(2)
+    # weight on root(1) branch flips the head
+    pa.apply_score_changes({1: 100}, (0, _root(0)), (0, _root(0)),
+                           (b"\x00" * 32, 0))
+    assert pa.find_head(_root(0)) == _root(1)
+
+
+def test_proto_array_deep_chain_weights_propagate():
+    pa = ProtoArray((0, _root(0)), (0, _root(0)))
+    pa.on_block(_node(0, _root(0), None))
+    for i in range(1, 10):
+        pa.on_block(_node(i, _root(i), i - 1))
+    pa.on_block(_node(5, _root(50), 4))  # fork off slot-4 node
+    pa.apply_score_changes({9: 10, 10: 5}, (0, _root(0)), (0, _root(0)),
+                           (b"\x00" * 32, 0))
+    assert pa.find_head(_root(0)) == _root(9)
+    # fork gains more weight
+    pa.apply_score_changes({10: 20}, (0, _root(0)), (0, _root(0)),
+                           (b"\x00" * 32, 0))
+    assert pa.find_head(_root(0)) == _root(50)
+
+
+def test_compute_deltas_vote_moves():
+    indices = {_root(1): 0, _root(2): 1}
+    votes = [VoteTracker(current_root=_root(1), next_root=_root(2),
+                         next_epoch=1)]
+    deltas = compute_deltas(indices, votes, np.array([5], np.uint64),
+                            np.array([7], np.uint64), set())
+    assert deltas == {0: -5, 1: 7}
+    assert votes[0].current_root == _root(2)
+
+
+def test_compute_deltas_equivocation_removes_weight():
+    indices = {_root(1): 0}
+    votes = [VoteTracker(current_root=_root(1), next_root=_root(1),
+                         next_epoch=1)]
+    deltas = compute_deltas(indices, votes, np.array([5], np.uint64),
+                            np.array([5], np.uint64), {0})
+    assert deltas == {0: -5}
+
+
+def test_proto_array_prune():
+    pa = ProtoArray((0, _root(0)), (0, _root(0)))
+    pa.prune_threshold = 2
+    pa.on_block(_node(0, _root(0), None))
+    for i in range(1, 6):
+        pa.on_block(_node(i, _root(i), i - 1))
+    pa.finalized_checkpoint = (1, _root(3))
+    pa.maybe_prune(_root(3))
+    assert _root(0) not in pa
+    assert _root(3) in pa
+    assert pa.get(_root(3)).parent is None
+    # find_head is only valid after apply_score_changes repairs links
+    pa.apply_score_changes({}, (0, _root(0)), (1, _root(3)),
+                           (b"\x00" * 32, 0))
+    assert pa.find_head(_root(3)) == _root(5)
+
+
+def test_payload_invalidation():
+    pa = ProtoArray((0, _root(0)), (0, _root(0)))
+    pa.on_block(_node(0, _root(0), None))
+    for i in range(1, 5):
+        n = _node(i, _root(i), i - 1)
+        n.execution_status = ExecutionStatus.OPTIMISTIC
+        n.execution_block_hash = bytes([0xE0 + i]) * 32
+        pa.on_block(n)
+    # invalidate from head, latest valid = block 2's payload
+    pa.process_execution_payload_invalidation(_root(4), bytes([0xE2]) * 32)
+    assert pa.get(_root(4)).execution_status == ExecutionStatus.INVALID
+    assert pa.get(_root(3)).execution_status == ExecutionStatus.INVALID
+    assert pa.get(_root(2)).execution_status == ExecutionStatus.VALID
+    pa.apply_score_changes({}, (0, _root(0)), (0, _root(0)),
+                           (b"\x00" * 32, 0))
+    assert pa.find_head(_root(0)) == _root(2)
+
+
+def test_fork_choice_end_to_end_with_chain():
+    """Drive ForkChoice with real blocks from the state harness."""
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = StateHarness(spec, 64)
+    from lighthouse_tpu.state_transition.helpers import (
+        get_indexed_attestation, latest_block_header_root,
+    )
+    genesis_root = latest_block_header_root(h.state)
+    fc = ForkChoice(spec, genesis_root, h.state)
+    blocks = []
+    for _ in range(spec.preset.slots_per_epoch * 4):
+        slot = h.state.slot + 1
+        atts = []
+        if slot > 1:
+            hdr = h.state.latest_block_header
+            if hdr.state_root == b"\x00" * 32:
+                hdr = h.T.BeaconBlockHeader(
+                    slot=hdr.slot, proposer_index=hdr.proposer_index,
+                    parent_root=hdr.parent_root,
+                    state_root=h.state.hash_tree_root(),
+                    body_root=hdr.body_root)
+            atts = h.produce_attestations(h.state, h.state.slot, htr(hdr))
+        pre = h.state
+        signed, post = h.produce_block_on_state(h.state, slot,
+                                                attestations=atts)
+        root = htr(signed.message)
+        fc.on_block(slot, signed.message, root, post,
+                    block_delay_seconds=1.0)
+        for a in atts:
+            fc.on_attestation(slot, get_indexed_attestation(post, a),
+                              is_from_block=True)
+        h.state = post
+        blocks.append((root, signed))
+        head = fc.get_head(slot)
+        assert head == root, "head should follow the canonical chain"
+    # justification propagated into fork choice
+    assert fc.justified_checkpoint[0] >= 1
+    assert fc.finalized_checkpoint[0] >= 1

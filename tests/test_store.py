@@ -1,0 +1,140 @@
+"""Store tests: native KV engine, hot/cold DB, replay reconstruction.
+
+Mirrors beacon_node/store tests (store_tests.rs style) at small scale.
+"""
+import os
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.ssz import htr
+from lighthouse_tpu.store import (
+    HotColdDB, MemoryStore, NativeKvStore, StoreConfig,
+)
+from lighthouse_tpu.testing import StateHarness
+
+
+def test_native_kv_roundtrip(tmp_path):
+    kv = NativeKvStore(tmp_path / "db.log")
+    kv.put(b"a\x00b", b"\x01\x02\x00\x03")
+    kv.put(b"a\x00c", b"x" * 100000)
+    kv.put(b"zz", b"")
+    assert kv.get(b"a\x00b") == b"\x01\x02\x00\x03"
+    assert len(kv.get(b"a\x00c")) == 100000
+    assert kv.get(b"zz") == b""
+    assert kv.get(b"missing") is None
+    kv.delete(b"a\x00b")
+    assert kv.get(b"a\x00b") is None
+    assert len(kv) == 2
+    kv.close()
+
+
+def test_native_kv_persistence_and_iteration(tmp_path):
+    path = tmp_path / "db.log"
+    kv = NativeKvStore(path)
+    for i in range(20):
+        kv.put(b"blk:" + bytes([i]), bytes([i]) * 10)
+    kv.put(b"oth:x", b"y")
+    kv.sync()
+    kv.close()
+    kv = NativeKvStore(path)
+    items = list(kv.iter_prefix(b"blk:"))
+    assert len(items) == 20
+    assert items[0][0] == b"blk:\x00"
+    assert items[5][1] == bytes([5]) * 10
+    # overwrite then compact keeps latest
+    kv.put(b"blk:\x00", b"new")
+    kv.compact()
+    assert kv.get(b"blk:\x00") == b"new"
+    assert kv.get(b"oth:x") == b"y"
+    kv.close()
+
+
+def test_native_kv_torn_tail_recovery(tmp_path):
+    path = tmp_path / "db.log"
+    kv = NativeKvStore(path)
+    kv.put(b"k1", b"v1")
+    kv.put(b"k2", b"v2")
+    kv.sync()
+    kv.close()
+    with open(path, "ab") as f:
+        f.write(b"\x05\x00\x00\x00garbage-partial-record")
+    kv = NativeKvStore(path)
+    assert kv.get(b"k1") == b"v1"
+    assert kv.get(b"k2") == b"v2"
+    kv.put(b"k3", b"v3")
+    kv.close()
+    kv = NativeKvStore(path)
+    assert kv.get(b"k3") == b"v3"
+    kv.close()
+
+
+@pytest.fixture
+def harness_chain():
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = StateHarness(spec, 64)
+    states = [h.genesis_state.copy()]
+    blocks = h.extend_chain(2 * spec.preset.slots_per_epoch)
+    return spec, h, blocks
+
+
+def _store_chain(db, h, blocks):
+    """Apply blocks through a replayer storing every block + state."""
+    from lighthouse_tpu.state_transition import BlockReplayer
+
+    from lighthouse_tpu.state_transition.helpers import (
+        latest_block_header_root,
+    )
+    state = h.genesis_state.copy()
+    db.store_genesis(latest_block_header_root(state), state)
+    roots = {}
+    for sb in blocks:
+        root = htr(sb.message)
+        db.put_block(root, sb)
+        st = BlockReplayer(state.copy()).apply_blocks([sb])
+        db.put_state(sb.message.state_root, st)
+        roots[sb.message.slot] = root
+        state = st
+    return state, roots
+
+
+def test_hot_cold_block_state_roundtrip(harness_chain, tmp_path):
+    spec, h, blocks = harness_chain
+    db = HotColdDB(NativeKvStore(tmp_path / "hot.db"),
+                   NativeKvStore(tmp_path / "cold.db"), spec)
+    final_state, roots = _store_chain(db, h, blocks)
+    # block roundtrip
+    root = htr(blocks[3].message)
+    assert htr(db.get_block(root).message) == root
+    # epoch-boundary state: direct load
+    boundary = blocks[spec.preset.slots_per_epoch - 1]
+    st = db.get_hot_state(boundary.message.state_root)
+    assert st is not None and st.hash_tree_root() == boundary.message.state_root
+    # mid-epoch state: summary + replay reconstruction
+    mid = blocks[spec.preset.slots_per_epoch + 2]
+    st = db.get_hot_state(mid.message.state_root)
+    assert st is not None
+    assert st.hash_tree_root() == mid.message.state_root
+
+
+def test_hot_cold_migration_and_cold_load(harness_chain, tmp_path):
+    spec, h, blocks = harness_chain
+    db = HotColdDB(MemoryStore(), MemoryStore(), spec,
+                   StoreConfig(slots_per_restore_point=8))
+    final_state, roots = _store_chain(db, h, blocks)
+    fin_slot = spec.preset.slots_per_epoch  # finalize end of epoch 1
+    fin_block = blocks[fin_slot - 1]
+    db.migrate_database(fin_slot, fin_block.message.state_root,
+                        htr(fin_block.message), roots)
+    assert db.split.slot == fin_slot
+    # hot states below split are pruned
+    early = blocks[2]
+    assert db.get_hot_state(early.message.state_root) is None
+    # but reconstructable from the freezer
+    st = db.load_cold_state_by_slot(early.message.slot)
+    assert st is not None
+    assert st.hash_tree_root() == early.message.state_root
+    # freezer block roots recorded
+    assert db.freezer_block_root_at_slot(3) == roots[3]
