@@ -1,0 +1,536 @@
+"""The BeaconChain service.
+
+Equivalent of /root/reference/beacon_node/beacon_chain/src/beacon_chain.rs
+(6855 LoC god-object): process_block (:3089), import_block (:3449),
+produce_block_on_state (:4810), batch attestation entry points (:1961,:2007),
+recompute_head (canonical_head.rs).
+
+Lock discipline (canonical_head.rs:1-32 contract, adapted): a single RLock
+guards {fork_choice, canonical head snapshot}; it is only taken inside this
+module's public methods and NEVER held across calls back into user code or
+the execution layer's blocking I/O — guards are never exposed.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..containers import get_types
+from ..containers.state import BeaconState
+from ..crypto import bls
+from ..fork_choice import ForkChoice
+from ..operation_pool import OperationPool
+from ..specs.chain_spec import ChainSpec, ForkName
+from ..ssz import htr
+from ..state_transition import (
+    VerifySignatures, per_block_processing, process_slots,
+)
+from ..state_transition.block import (
+    BlockProcessingError, compute_timestamp_at_slot, get_expected_withdrawals,
+)
+from ..state_transition.helpers import (
+    compute_epoch_at_slot, compute_start_slot_at_epoch,
+    get_beacon_proposer_index, get_indexed_attestation,
+    latest_block_header_root,
+)
+from ..store import HotColdDB
+from ..utils.slot_clock import SlotClock
+from . import attestation_verification as att_verify
+from . import block_verification as blk_verify
+from .errors import INVALID_BLOCK, PARENT_UNKNOWN, BlockError
+from .events import EventHandler
+from .execution import ExecutionLayerInterface
+from .observed import (
+    ObservedAggregates, ObservedAttesters, ObservedBlobSidecars,
+    ObservedBlockProducers, ObservedOperations, ObservedSlashable,
+)
+
+
+@dataclass
+class ChainConfig:
+    snapshot_cache_size: int = 8
+    reorg_threshold_pct: int = 20
+    enable_light_client_server: bool = True
+
+
+@dataclass
+class CanonicalHead:
+    head_block_root: bytes
+    head_block: object
+    head_state: BeaconState
+
+
+class BeaconChain:
+    def __init__(self, spec: ChainSpec, store: HotColdDB,
+                 slot_clock: SlotClock,
+                 execution_layer: ExecutionLayerInterface,
+                 genesis_state: BeaconState, genesis_block,
+                 config: ChainConfig | None = None):
+        self.spec = spec
+        self.T = get_types(spec.preset)
+        self.store = store
+        self.slot_clock = slot_clock
+        self.execution_layer = execution_layer
+        self.config = config or ChainConfig()
+
+        self.genesis_state = genesis_state
+        self.genesis_block_root = latest_block_header_root(genesis_state)
+        self.genesis_validators_root = genesis_state.genesis_validators_root
+
+        self._lock = threading.RLock()
+        self.fork_choice = ForkChoice(spec, self.genesis_block_root,
+                                      genesis_state)
+        self.canonical_head = CanonicalHead(
+            self.genesis_block_root, genesis_block, genesis_state)
+
+        # caches (the reference's ~15 specialized caches, folded)
+        self._snapshots: OrderedDict[bytes, BeaconState] = OrderedDict()
+        self._snapshots[self.genesis_block_root] = genesis_state
+
+        self.observed_block_producers = ObservedBlockProducers()
+        self.observed_attesters = ObservedAttesters()
+        self.observed_aggregators = ObservedAttesters()
+        self.observed_aggregates = ObservedAggregates()
+        self.observed_sync_contributors = ObservedAttesters()
+        self.observed_blob_sidecars = ObservedBlobSidecars()
+        self.observed_operations = ObservedOperations()
+        self.observed_slashable = ObservedSlashable()
+
+        self.op_pool = OperationPool(self.T)
+        self.events = EventHandler()
+        self.block_times: dict[bytes, dict] = {}
+        self.validator_monitor = None  # wired by the client builder
+
+        store.store_genesis(self.genesis_block_root, genesis_state)
+        if genesis_block is not None:
+            store.put_block(self.genesis_block_root, genesis_block)
+
+    # -- time / status -------------------------------------------------------
+
+    def slot(self) -> int:
+        s = self.slot_clock.now()
+        return s if s is not None else 0
+
+    def epoch(self) -> int:
+        return self.slot() // self.spec.preset.slots_per_epoch
+
+    def finalized_checkpoint(self) -> tuple[int, bytes]:
+        return self.fork_choice.finalized_checkpoint
+
+    def justified_checkpoint(self) -> tuple[int, bytes]:
+        return self.fork_choice.justified_checkpoint
+
+    def head(self) -> CanonicalHead:
+        with self._lock:
+            return self.canonical_head
+
+    def head_state_copy(self) -> BeaconState:
+        with self._lock:
+            return self.canonical_head.head_state.copy()
+
+    # -- state resolution ----------------------------------------------------
+
+    def _state_for(self, block_root: bytes) -> BeaconState | None:
+        st = self._snapshots.get(block_root)
+        if st is not None:
+            return st
+        blk = self.store.get_block(block_root)
+        if blk is None:
+            return None
+        return self.store.get_hot_state(blk.message.state_root)
+
+    def _cache_snapshot(self, block_root: bytes, state: BeaconState) -> None:
+        self._snapshots[block_root] = state
+        self._snapshots.move_to_end(block_root)
+        while len(self._snapshots) > self.config.snapshot_cache_size:
+            old_root, _ = self._snapshots.popitem(last=False)
+            if old_root == self.canonical_head.head_block_root:
+                self._snapshots[old_root] = \
+                    self.canonical_head.head_state
+                if len(self._snapshots) <= self.config.snapshot_cache_size:
+                    break
+
+    def state_for_block_production(self, parent_root: bytes,
+                                   slot: int) -> BeaconState:
+        """Parent state advanced to `slot` (cheap_state_advance analog —
+        committees/proposers only need the slot advance)."""
+        st = self._state_for(parent_root)
+        if st is None:
+            raise BlockError(PARENT_UNKNOWN, parent_root.hex())
+        st = st.copy()
+        if st.slot < slot:
+            process_slots(st, slot)
+        return st
+
+    def state_for_block_import(self, parent_root: bytes,
+                               slot: int) -> BeaconState:
+        return self.state_for_block_production(parent_root, slot)
+
+    def state_for_attestation(self, data) -> BeaconState:
+        """A state that can compute committees for data's target epoch."""
+        st = self._state_for(data.beacon_block_root)
+        if st is None:
+            raise BlockError(PARENT_UNKNOWN, data.beacon_block_root.hex())
+        target_start = compute_start_slot_at_epoch(
+            data.target.epoch, self.spec.preset.slots_per_epoch)
+        if st.slot < target_start:
+            st = st.copy()
+            process_slots(st, target_start)
+        return st
+
+    # -- block processing ----------------------------------------------------
+
+    def verify_block_for_gossip(self, signed_block):
+        return blk_verify.verify_block_for_gossip(self, signed_block)
+
+    def process_block(self, signed_block,
+                      proposal_already_verified: bool = False) -> bytes:
+        """Full import pipeline (beacon_chain.rs:3089): signatures (batched)
+        -> state transition -> payload -> fork choice -> store -> head."""
+        block = signed_block.message
+        block_root = htr(block)
+        if self.fork_choice.contains_block(block_root):
+            return block_root
+        if not self.fork_choice.contains_block(block.parent_root):
+            raise BlockError(PARENT_UNKNOWN, block.parent_root.hex())
+        sv = blk_verify.into_signature_verified(
+            self, signed_block, block_root, proposal_already_verified)
+        ep = blk_verify.into_execution_pending(self, sv)
+        return self.import_block(ep)
+
+    def import_block(self, ep) -> bytes:
+        """beacon_chain.rs:3449 import_block: fork choice + store + head."""
+        block = ep.signed_block.message
+        block_root = ep.block_root
+        state = ep.post_state
+        from ..fork_choice.proto_array import ExecutionStatus
+        status = {"valid": ExecutionStatus.VALID,
+                  "optimistic": ExecutionStatus.OPTIMISTIC,
+                  "irrelevant": ExecutionStatus.IRRELEVANT}[ep.payload_status]
+        current_slot = max(self.slot(), block.slot)
+        delay = None
+        if self.slot_clock.now() == block.slot:
+            delay = self.slot_clock.seconds_into_slot()
+        with self._lock:
+            self.fork_choice.on_block(current_slot, block, block_root, state,
+                                      block_delay_seconds=delay,
+                                      execution_status=status)
+            # on-block attestations feed LMD votes (is_from_block)
+            for att in block.body.attestations:
+                try:
+                    indexed = get_indexed_attestation(state, att)
+                    self.fork_choice.on_attestation(current_slot, indexed,
+                                                    is_from_block=True)
+                except Exception as e:  # votes are best-effort, but loudly
+                    import logging
+                    logging.getLogger("lighthouse_tpu.chain").warning(
+                        "on-block attestation skipped in fork choice: %r", e)
+            for slashing in block.body.attester_slashings:
+                self.fork_choice.on_attester_slashing(slashing.attestation_1)
+            self.store.put_block(block_root, ep.signed_block)
+            self.store.put_state(block.state_root, state)
+            self._cache_snapshot(block_root, state)
+        self.events.emit("block", {"slot": block.slot,
+                                   "block_root": block_root})
+        self.recompute_head()
+        return block_root
+
+    def process_chain_segment(self, blocks: list) -> int:
+        """Range-sync import. Per epoch-aligned chunk: signatures are batched
+        and verified FIRST against a cheap slot-advanced state (committees
+        and proposers don't depend on the chunk's own blocks), then the full
+        state transitions run — so garbage signatures are rejected before any
+        expensive per-block processing (block_verification.rs:591 order).
+        Returns the number of imported blocks."""
+        if not blocks:
+            return 0
+        blocks = [b for b in blocks
+                  if not self.fork_choice.contains_block(htr(b.message))]
+        if not blocks:
+            return 0
+        first = blocks[0].message
+        if not self.fork_choice.contains_block(first.parent_root):
+            raise BlockError(PARENT_UNKNOWN, first.parent_root.hex())
+        from ..state_transition.signature_sets import BlockSignatureVerifier
+        spe = self.spec.preset.slots_per_epoch
+        chunks: list[list] = []
+        for sb in blocks:
+            if chunks and chunks[-1][-1].message.slot // spe == \
+                    sb.message.slot // spe:
+                chunks[-1].append(sb)
+            else:
+                chunks.append([sb])
+        state = self.state_for_block_import(first.parent_root, first.slot)
+        staged = []
+        prev_root = first.parent_root
+        for chunk in chunks:
+            # phase 1: batched signature verification on a scratch advance
+            # (zeroed state roots — committees/domains don't need them; block
+            # roots are patched in from the segment so sync-aggregate signing
+            # roots are exact)
+            scratch = state.copy()
+            p = self.spec.preset
+            sets = []
+            last_root = prev_root
+            for sb in chunk:
+                block = sb.message
+                while scratch.slot < block.slot:
+                    from ..state_transition.slot import per_slot_processing
+                    slot_now = scratch.slot
+                    per_slot_processing(scratch, state_root=b"\x00" * 32)
+                    import numpy as _np
+                    scratch.block_roots[
+                        slot_now % p.slots_per_historical_root] = \
+                        _np.frombuffer(last_root, _np.uint8)
+                v = BlockSignatureVerifier(scratch)
+                v.include_entire_block(sb, htr(block))
+                sets.extend(v.sets)
+                last_root = htr(block)
+            if sets and not bls.verify_signature_sets(sets):
+                raise BlockError("invalid_signature", "chain segment batch")
+            # phase 2: real transitions
+            for sb in chunk:
+                block = sb.message
+                root = htr(block)
+                if state.slot < block.slot:
+                    process_slots(state, block.slot)
+                try:
+                    per_block_processing(state, sb, VerifySignatures.FALSE,
+                                         block_root=root)
+                except BlockProcessingError as e:
+                    raise BlockError(INVALID_BLOCK, str(e)) from e
+                if block.state_root != state.hash_tree_root():
+                    raise BlockError(INVALID_BLOCK,
+                                     "segment state root mismatch")
+                staged.append((sb, root, state.copy()))
+            prev_root = staged[-1][1]
+        imported = 0
+        for sb, root, post in staged:
+            payload_status = "irrelevant"
+            if post.fork_name >= ForkName.BELLATRIX and \
+                    hasattr(sb.message.body, "execution_payload"):
+                payload_status = self.execution_layer.notify_new_payload(
+                    sb.message.body.execution_payload)
+                if payload_status == "invalid":
+                    raise BlockError("execution_invalid", root.hex())
+            self.import_block(blk_verify.ExecutionPendingBlock(
+                sb, root, post, payload_status))
+            imported += 1
+        return imported
+
+    # -- head ----------------------------------------------------------------
+
+    def recompute_head(self) -> bytes:
+        """canonical_head.rs recompute_head_at_current_slot.
+
+        The lock covers only the fork-choice run + head swap; execution-layer
+        I/O and store migration happen strictly after release (the
+        canonical_head.rs:9-32 'never hold across EL calls' contract).
+        """
+        with self._lock:
+            old = self.canonical_head
+            head_root = self.fork_choice.get_head(self.slot())
+            if head_root != old.head_block_root:
+                head_block = self.store.get_block(head_root)
+                head_state = self._state_for(head_root)
+                if head_state is None:
+                    raise BlockError("missing_state", head_root.hex())
+                new_head = CanonicalHead(head_root, head_block, head_state)
+                reorg = old.head_block_root != (
+                    head_block.message.parent_root if head_block else None)
+                self.canonical_head = new_head
+                self.events.emit("head", {
+                    "slot": head_state.slot, "block": head_root,
+                    "previous": old.head_block_root})
+                if reorg and head_block is not None and \
+                        old.head_block is not None and \
+                        old.head_block_root != self.genesis_block_root:
+                    self.events.emit("chain_reorg", {
+                        "old_head": old.head_block_root,
+                        "new_head": head_root})
+            head_state = self.canonical_head.head_state
+            fin_root = self.fork_choice.finalized_checkpoint[1]
+        # ---- lock released: blocking work below ----
+        self._after_finalization_check()
+        if head_state.fork_name >= ForkName.BELLATRIX and \
+                head_state.latest_execution_payload_header is not None:
+            fin_block = self.store.get_block(fin_root)
+            fin_hash = b"\x00" * 32
+            if fin_block is not None and \
+                    hasattr(fin_block.message.body, "execution_payload"):
+                fin_hash = \
+                    fin_block.message.body.execution_payload.block_hash
+            self.execution_layer.notify_forkchoice_updated(
+                head_state.latest_execution_payload_header.block_hash,
+                fin_hash, fin_hash)
+        return head_root
+
+    _last_pruned_finalized = 0
+
+    def _after_finalization_check(self) -> None:
+        fin_epoch, fin_root = self.fork_choice.finalized_checkpoint
+        if fin_epoch <= self._last_pruned_finalized or fin_epoch == 0:
+            return
+        self._last_pruned_finalized = fin_epoch
+        p = self.spec.preset
+        fin_slot = fin_epoch * p.slots_per_epoch
+        self.observed_block_producers.prune(fin_slot)
+        self.observed_blob_sidecars.prune(fin_slot)
+        self.observed_slashable.prune(fin_slot)
+        self.observed_attesters.prune(fin_epoch - 1)
+        self.observed_aggregators.prune(fin_slot)
+        self.observed_aggregates.prune(fin_slot)
+        self.observed_sync_contributors.prune(fin_slot)
+        self.fork_choice.prune()
+        self.events.emit("finalized_checkpoint",
+                         {"epoch": fin_epoch, "root": fin_root})
+        # migrate finalized data to the freezer
+        fin_block = self.store.get_block(fin_root)
+        if fin_block is not None:
+            canonical: dict[int, bytes] = {}
+            last_root = None
+            for root, slot in self.store.iter_block_roots_back(fin_root):
+                canonical[slot] = root
+                if slot <= self.store.split.slot:
+                    break
+            # fill skipped slots with the most recent root at-or-before
+            filled: dict[int, bytes] = {}
+            cur = None
+            for s in range(self.store.split.slot, fin_slot + 1):
+                if s in canonical:
+                    cur = canonical[s]
+                if cur is not None:
+                    filled[s] = cur
+            self.store.migrate_database(
+                fin_slot, fin_block.message.state_root, fin_root, filled)
+        self.op_pool.prune(self.canonical_head.head_state)
+
+    # -- per-slot tasks ------------------------------------------------------
+
+    def per_slot_task(self) -> None:
+        """timer/src/lib.rs tick + state_advance_timer: advance fork choice
+        time and pre-advance the head state across the epoch boundary."""
+        slot = self.slot()
+        with self._lock:
+            self.fork_choice.update_time(slot)
+
+    # -- attestation entry points -------------------------------------------
+
+    def verify_unaggregated_attestation_for_gossip(self, attestation,
+                                                   subnet_id=None):
+        return att_verify.verify_unaggregated_for_gossip(self, attestation,
+                                                         subnet_id)
+
+    def batch_verify_unaggregated_attestations_for_gossip(self, pairs):
+        return att_verify.batch_verify_unaggregated_for_gossip(self, pairs)
+
+    def verify_aggregated_attestation_for_gossip(self, signed_aggregate):
+        return att_verify.verify_aggregated_for_gossip(self, signed_aggregate)
+
+    def batch_verify_aggregated_attestations_for_gossip(self, aggs):
+        return att_verify.batch_verify_aggregated_for_gossip(self, aggs)
+
+    def apply_attestation_to_fork_choice(self, verified) -> None:
+        with self._lock:
+            self.fork_choice.on_attestation(self.slot(), verified.indexed,
+                                            is_from_block=False)
+
+    def add_to_op_pool(self, verified_attestation) -> None:
+        att = getattr(verified_attestation, "attestation", None)
+        if att is None:
+            att = verified_attestation.signed_aggregate.message.aggregate
+        self.op_pool.insert_attestation(att)
+
+    # -- block production ----------------------------------------------------
+
+    def produce_block(self, randao_reveal: bytes, slot: int,
+                      graffiti: bytes = b"\x00" * 32,
+                      skip_randao_verification: bool = False):
+        """3-phase production (beacon_chain.rs:4810): (1) state advance +
+        op-pool packing, (2) payload retrieval, (3) completion + state root.
+        Returns (block, post_state)."""
+        with self._lock:
+            head = self.canonical_head
+            parent_root = head.head_block_root
+            state = head.head_state.copy()
+        if state.slot < slot:
+            process_slots(state, slot)
+        fork = state.fork_name
+        T = self.T
+        proposer_index = get_beacon_proposer_index(state, slot)
+
+        attestations = self.op_pool.get_attestations_for_block(state)
+        proposer_sl, attester_sl, exits, changes = \
+            self.op_pool.get_slashings_and_exits(state)
+
+        body_cls = T.BeaconBlockBody[fork]
+        body = body_cls(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data, graffiti=graffiti,
+            proposer_slashings=proposer_sl,
+            attester_slashings=attester_sl,
+            attestations=attestations, deposits=[],
+            voluntary_exits=exits)
+        if fork >= ForkName.CAPELLA:
+            body.bls_to_execution_changes = changes
+        if fork >= ForkName.ALTAIR:
+            body.sync_aggregate = self._empty_sync_aggregate()
+        if fork >= ForkName.BELLATRIX:
+            body.execution_payload = self._produce_payload(state, fork)
+
+        block = T.BeaconBlock[fork](
+            slot=slot, proposer_index=proposer_index,
+            parent_root=parent_root, state_root=b"\x00" * 32, body=body)
+        signed_cls = T.SignedBeaconBlock[fork]
+        unsigned = signed_cls(message=block,
+                              signature=bls.INFINITY_SIGNATURE)
+        post = state.copy()
+        per_block_processing(post, unsigned, VerifySignatures.FALSE)
+        block.state_root = post.hash_tree_root()
+        return block, post
+
+    def _empty_sync_aggregate(self):
+        return self.T.SyncAggregate(
+            sync_committee_bits=[False] * self.spec.preset.sync_committee_size,
+            sync_committee_signature=bls.INFINITY_SIGNATURE)
+
+    def _produce_payload(self, state: BeaconState, fork: ForkName):
+        """Local mock-EL payload (the real EL round-trip lives in
+        lighthouse_tpu.execution_layer)."""
+        cls = self.T.ExecutionPayload[fork]
+        parent_hash = state.latest_execution_payload_header.block_hash
+        kw = dict(
+            parent_hash=parent_hash,
+            prev_randao=state.get_randao_mix(state.current_epoch()),
+            block_number=state.latest_execution_payload_header.block_number
+            + 1,
+            timestamp=compute_timestamp_at_slot(state, state.slot),
+            block_hash=htr(self.T.Checkpoint(epoch=state.slot,
+                                             root=parent_hash)),
+            base_fee_per_gas=7)
+        if fork >= ForkName.CAPELLA:
+            withdrawals, _ = get_expected_withdrawals(state)
+            kw["withdrawals"] = withdrawals
+        return cls(**kw)
+
+    # -- processing status ---------------------------------------------------
+
+    def is_optimistic_head(self) -> bool:
+        with self._lock:
+            return self.fork_choice.is_optimistic(
+                self.canonical_head.head_block_root)
+
+    def block_root_at_slot(self, slot: int) -> bytes | None:
+        """Canonical block root at slot, from the head state's history."""
+        with self._lock:
+            st = self.canonical_head.head_state
+            p = self.spec.preset
+            if slot == st.slot:
+                return self.canonical_head.head_block_root
+            if slot < st.slot <= slot + p.slots_per_historical_root:
+                return st.get_block_root_at_slot(slot)
+        root = self.store.freezer_block_root_at_slot(slot)
+        return root

@@ -1,0 +1,150 @@
+"""Block verification pipeline (type-state).
+
+Equivalent of /root/reference/beacon_node/beacon_chain/src/block_verification.rs:
+GossipVerifiedBlock (:662) -> SignatureVerifiedBlock (:671) ->
+ExecutionPendingBlock (:693) -> ExecutedBlock. Each stage owns the evidence of
+the checks already performed, so later stages never re-verify; the signature
+stage funnels every signature in the block into ONE batched TPU-bound
+`verify_signature_sets` call (signature_verify_chain_segment :591 batches
+whole sync segments the same way).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto import bls
+from ..specs.chain_spec import ForkName
+from ..ssz import htr
+from ..state_transition import (
+    VerifySignatures, per_block_processing, process_slots,
+)
+from ..state_transition.block import BlockProcessingError
+from ..state_transition.helpers import (
+    compute_epoch_at_slot, get_beacon_proposer_index,
+)
+from ..state_transition.signature_sets import (
+    BlockSignatureVerifier, block_proposal_signature_set,
+)
+from .errors import (
+    ALREADY_KNOWN, FINALIZED_SLOT, FUTURE_SLOT, INCORRECT_PROPOSER,
+    INVALID_BLOCK, INVALID_SIGNATURE, PARENT_UNKNOWN, REPEAT_PROPOSAL,
+    BlockError,
+)
+
+
+@dataclass
+class GossipVerifiedBlock:
+    """Gossip-propagation checks + proposer signature verified
+    (block_verification.rs:793 GossipVerifiedBlock::new)."""
+    signed_block: object
+    block_root: bytes
+
+
+@dataclass
+class SignatureVerifiedBlock:
+    """All block signatures verified against the parent-derived state."""
+    signed_block: object
+    block_root: bytes
+    state: object           # parent state advanced to block.slot
+    consensus_verified: bool = False
+
+
+@dataclass
+class ExecutionPendingBlock:
+    """State transition applied; execution-payload status may still be
+    optimistic (resolved by the execution layer)."""
+    signed_block: object
+    block_root: bytes
+    post_state: object
+    payload_status: str     # "valid" | "optimistic" | "irrelevant"
+
+
+def verify_block_for_gossip(chain, signed_block) -> GossipVerifiedBlock:
+    block = signed_block.message
+    block_root = htr(block)
+
+    current_slot = chain.slot()
+    disparity_slots = 0  # MAXIMUM_GOSSIP_CLOCK_DISPARITY folded into slot 0
+    if block.slot > current_slot + disparity_slots:
+        raise BlockError(FUTURE_SLOT, f"block slot {block.slot}")
+    finalized_slot = chain.finalized_checkpoint()[0] * \
+        chain.spec.preset.slots_per_epoch
+    if block.slot <= finalized_slot:
+        raise BlockError(FINALIZED_SLOT, f"slot {block.slot}")
+    if chain.fork_choice.contains_block(block_root):
+        raise BlockError(ALREADY_KNOWN, block_root.hex())
+
+    seen = chain.observed_block_producers.proposer_has_been_observed(
+        block.slot, block.proposer_index, block_root)
+    if seen == "duplicate":
+        raise BlockError(ALREADY_KNOWN, "proposal already seen")
+    if seen == "slashable":
+        chain.observed_slashable.observe(block.slot, block.proposer_index,
+                                         block_root)
+        raise BlockError(REPEAT_PROPOSAL,
+                         f"proposer {block.proposer_index} equivocated")
+
+    if not chain.fork_choice.contains_block(block.parent_root):
+        raise BlockError(PARENT_UNKNOWN, block.parent_root.hex())
+
+    # proposer shuffling via cheap state advance of the parent state
+    # (beacon_chain.rs:2062)
+    state = chain.state_for_block_production(block.parent_root, block.slot)
+    expected_proposer = get_beacon_proposer_index(state, block.slot)
+    if block.proposer_index != expected_proposer:
+        raise BlockError(INCORRECT_PROPOSER,
+                         f"got {block.proposer_index}, "
+                         f"expected {expected_proposer}")
+
+    # proposer signature (beacon_chain.rs:2140)
+    s = block_proposal_signature_set(state, signed_block, block_root)
+    if not bls.verify_signature_sets([s]):
+        raise BlockError(INVALID_SIGNATURE, "proposer signature")
+
+    chain.observed_block_producers.observe(block.slot, block.proposer_index,
+                                           block_root)
+    chain.observed_slashable.observe(block.slot, block.proposer_index,
+                                     block_root)
+    return GossipVerifiedBlock(signed_block, block_root)
+
+
+def into_signature_verified(chain, signed_block, block_root: bytes,
+                            proposal_already_verified: bool
+                            ) -> SignatureVerifiedBlock:
+    """Batch-verify every signature in the block
+    (BlockSignatureVerifier::verify_entire_block via block_verification.rs:1286)."""
+    block = signed_block.message
+    state = chain.state_for_block_import(block.parent_root, block.slot)
+    verifier = BlockSignatureVerifier(state)
+    verifier.include_entire_block(signed_block, block_root)
+    if proposal_already_verified:
+        verifier.sets = verifier.sets[1:]  # proposal set is always first
+    if not verifier.verify():
+        raise BlockError(INVALID_SIGNATURE, "block signature batch")
+    return SignatureVerifiedBlock(signed_block, block_root, state)
+
+
+def into_execution_pending(chain, sv: SignatureVerifiedBlock
+                           ) -> ExecutionPendingBlock:
+    block = sv.signed_block.message
+    state = sv.state
+    try:
+        per_block_processing(state, sv.signed_block, VerifySignatures.FALSE,
+                             block_root=sv.block_root)
+    except BlockProcessingError as e:
+        raise BlockError(INVALID_BLOCK, str(e)) from e
+    if block.state_root != state.hash_tree_root():
+        raise BlockError(INVALID_BLOCK, "state root mismatch")
+
+    payload_status = "irrelevant"
+    if state.fork_name >= ForkName.BELLATRIX and \
+            hasattr(block.body, "execution_payload"):
+        payload_status = chain.execution_layer.notify_new_payload(
+            block.body.execution_payload)
+        if payload_status == "invalid":
+            from .errors import EXECUTION_INVALID
+            raise BlockError(EXECUTION_INVALID, "EL rejected payload")
+    return ExecutionPendingBlock(sv.signed_block, sv.block_root, state,
+                                 payload_status)
+
+

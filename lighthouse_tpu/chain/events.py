@@ -1,0 +1,41 @@
+"""Server-sent-event style chain event bus
+(/root/reference/beacon_node/beacon_chain/src/events.rs)."""
+from __future__ import annotations
+
+import queue
+import threading
+
+
+EVENT_KINDS = ("head", "block", "attestation", "finalized_checkpoint",
+               "chain_reorg", "voluntary_exit", "blob_sidecar",
+               "payload_attributes", "block_gossip")
+
+
+class EventHandler:
+    def __init__(self, capacity: int = 1024):
+        self._subs: list[tuple[set[str], queue.Queue]] = []
+        self._lock = threading.Lock()
+        self.capacity = capacity
+
+    def subscribe(self, kinds=None) -> queue.Queue:
+        q: queue.Queue = queue.Queue(self.capacity)
+        with self._lock:
+            self._subs.append((set(kinds or EVENT_KINDS), q))
+        return q
+
+    def unsubscribe(self, q: queue.Queue) -> None:
+        with self._lock:
+            self._subs = [(k, s) for k, s in self._subs if s is not q]
+
+    def emit(self, kind: str, payload) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for kinds, q in subs:
+            if kind in kinds:
+                try:
+                    q.put_nowait((kind, payload))
+                except queue.Full:
+                    pass
+
+    def has_subscribers(self) -> bool:
+        return bool(self._subs)
