@@ -1,0 +1,71 @@
+"""Headline benchmark (run by the driver on real TPU hardware).
+
+Prints ONE JSON line. Current primary metric: BeaconState tree_hash_root at
+1M validators on one chip (BASELINE.md north star 2: < 200 ms;
+vs_baseline = 200 / measured_ms, so >= 1.0 meets the target). The BLS batch
+metric switches in when the pairing kernel lands (ops/bls12_381).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+N_VALIDATORS = 1_000_000
+TARGET_MS = 200.0
+
+
+def build_state_columns(n):
+    rng = np.random.default_rng(7)
+    from lighthouse_tpu.containers.state import ValidatorRegistry
+    vr = ValidatorRegistry.__new__(ValidatorRegistry)
+    vr.pubkeys = rng.integers(0, 256, size=(n, 48), dtype=np.uint8)
+    vr.withdrawal_credentials = rng.integers(0, 256, size=(n, 32),
+                                             dtype=np.uint8)
+    vr.effective_balance = np.full(n, 32 * 10**9, dtype=np.uint64)
+    vr.slashed = np.zeros(n, dtype=bool)
+    vr.activation_eligibility_epoch = np.zeros(n, dtype=np.uint64)
+    vr.activation_epoch = np.zeros(n, dtype=np.uint64)
+    vr.exit_epoch = np.full(n, 2**64 - 1, dtype=np.uint64)
+    vr.withdrawable_epoch = np.full(n, 2**64 - 1, dtype=np.uint64)
+    vr._dirty = True
+    vr._root_cache = None
+    balances = rng.integers(31 * 10**9, 33 * 10**9, size=n, dtype=np.uint64)
+    return vr, balances
+
+
+def bench_tree_hash():
+    from lighthouse_tpu.containers.state import _np_uint_root
+    vr, balances = build_state_columns(N_VALIDATORS)
+    vrl = 2**40
+
+    def run():
+        vr._dirty = True
+        v_root = vr.hash_tree_root(vrl)
+        b_root = _np_uint_root(balances, (vrl * 8 + 31) // 32,
+                               length=N_VALIDATORS)
+        return v_root, b_root
+
+    run()  # warm up compiles
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        times.append((time.perf_counter() - t0) * 1000)
+    return min(times)
+
+
+def main():
+    ms = bench_tree_hash()
+    print(json.dumps({
+        "metric": "beacon_state_tree_hash_1m_validators",
+        "value": round(ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

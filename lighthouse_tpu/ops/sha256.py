@@ -65,6 +65,9 @@ def sha256_compress(state: jax.Array, block: jax.Array) -> jax.Array:
     identical steady-state throughput, since rounds are sequential anyway and
     the batch dimension stays fully vectorized inside each iteration.
     """
+    # tie the carry's device-varying type to the block's (shard_map vma:
+    # a constant-IV carry would otherwise mismatch the varying scan inputs)
+    state = state + (block[..., :8] & np.uint32(0))
     # message schedule: W[64, ...] via a rolling 16-word window
     w_first = jnp.moveaxis(block, -1, 0)  # [16, ...]
 

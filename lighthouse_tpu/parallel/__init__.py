@@ -1,0 +1,9 @@
+"""Device-mesh parallelism (the ICI/DCN plane).
+
+SURVEY.md §5.8: the reference's intra-node parallelism is blst's multicore
+multi-pairing fan-out and rayon sweeps; the TPU-native equivalent shards
+signature-set batches and merkle subtrees across chips with `shard_map` over a
+`jax.sharding.Mesh`, with XLA collectives (all_gather/psum) riding ICI.
+"""
+from .mesh import batch_mesh, shard_batch
+from .merkle import sharded_merkleize, sharded_state_root_step

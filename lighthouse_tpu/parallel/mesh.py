@@ -1,0 +1,20 @@
+"""Mesh helpers."""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_mesh(n_devices: int | None = None,
+               axis: str = "batch") -> Mesh:
+    """1-D data-parallel mesh over the first n devices."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_batch(mesh: Mesh, arr, axis: str = "batch"):
+    """Place an array row-sharded over the mesh's batch axis."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.device_put(arr, sharding)
