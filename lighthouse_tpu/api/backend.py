@@ -1,0 +1,308 @@
+"""Beacon-API semantics over a BeaconChain.
+
+The single implementation behind both the HTTP router and the in-process
+BeaconNodeInterface used by the validator client and simulator (the
+reference's http_api handlers + common/eth2 typed client collapsed onto one
+seam).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..chain.beacon_chain import BeaconChain
+from ..specs.chain_spec import ForkName
+from ..ssz import htr
+from ..state_transition import process_slots
+from ..state_transition.helpers import (
+    committee_cache, compute_epoch_at_slot, compute_start_slot_at_epoch,
+    get_beacon_proposer_index,
+)
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        super().__init__(message)
+
+
+class ApiBackend:
+    def __init__(self, chain: BeaconChain):
+        self.chain = chain
+
+    # -- node ----------------------------------------------------------------
+
+    def is_healthy(self) -> bool:
+        return True
+
+    def syncing(self) -> dict:
+        head = self.chain.head().head_state.slot
+        current = self.chain.slot()
+        return {"head_slot": str(head),
+                "sync_distance": str(max(0, current - head)),
+                "is_syncing": current > head + 1,
+                "is_optimistic": self.chain.is_optimistic_head(),
+                "el_offline": False}
+
+    def version(self) -> dict:
+        from .. import __version__
+        return {"version": f"lighthouse-tpu/{__version__}"}
+
+    # -- beacon --------------------------------------------------------------
+
+    def genesis(self) -> dict:
+        st = self.chain.genesis_state
+        return {"genesis_time": str(st.genesis_time),
+                "genesis_validators_root":
+                    "0x" + st.genesis_validators_root.hex(),
+                "genesis_fork_version":
+                    "0x" + self.chain.spec.genesis_fork_version.hex()}
+
+    def _resolve_state(self, state_id: str):
+        chain = self.chain
+        if state_id == "head":
+            return chain.head().head_state
+        if state_id == "genesis":
+            return chain.genesis_state
+        if state_id in ("finalized", "justified"):
+            epoch, root = (chain.finalized_checkpoint()
+                           if state_id == "finalized"
+                           else chain.justified_checkpoint())
+            blk = chain.store.get_block(root)
+            if blk is None:
+                return chain.head().head_state
+            st = chain.store.get_hot_state(blk.message.state_root)
+            if st is None:
+                raise ApiError(404, "state not available")
+            return st
+        if state_id.startswith("0x"):
+            st = chain.store.get_hot_state(bytes.fromhex(state_id[2:]))
+            if st is None:
+                raise ApiError(404, "state not found")
+            return st
+        try:
+            slot = int(state_id)
+        except ValueError:
+            raise ApiError(400, f"bad state id {state_id}")
+        head = chain.head().head_state
+        if slot > head.slot:
+            raise ApiError(404, "future state")
+        root = chain.block_root_at_slot(slot)
+        if root is None:
+            raise ApiError(404, "unknown slot")
+        blk = chain.store.get_block(root)
+        st = chain.store.get_hot_state(blk.message.state_root) if blk else None
+        if st is None:
+            raise ApiError(404, "state pruned")
+        if st.slot < slot:
+            st = st.copy()
+            process_slots(st, slot)
+        return st
+
+    def state_root(self, state_id: str) -> bytes:
+        return self._resolve_state(state_id).hash_tree_root()
+
+    def state_fork(self, state_id: str) -> dict:
+        f = self._resolve_state(state_id).fork
+        return {"previous_version": "0x" + f.previous_version.hex(),
+                "current_version": "0x" + f.current_version.hex(),
+                "epoch": str(f.epoch)}
+
+    def finality_checkpoints(self, state_id: str) -> dict:
+        st = self._resolve_state(state_id)
+        def ck(c):
+            return {"epoch": str(c.epoch), "root": "0x" + c.root.hex()}
+        return {"previous_justified": ck(st.previous_justified_checkpoint),
+                "current_justified": ck(st.current_justified_checkpoint),
+                "finalized": ck(st.finalized_checkpoint)}
+
+    def validators(self, state_id: str,
+                   indices: list[int] | None = None) -> list[dict]:
+        st = self._resolve_state(state_id)
+        out = []
+        epoch = st.current_epoch()
+        n = len(st.validators)
+        for i in (indices if indices is not None else range(n)):
+            if i >= n:
+                continue
+            v = st.validators.view(i)
+            if v.activation_epoch > epoch:
+                status = ("pending_queued"
+                          if v.activation_eligibility_epoch <= epoch
+                          else "pending_initialized")
+            elif epoch < v.exit_epoch:
+                status = "active_slashed" if v.slashed else "active_ongoing"
+            elif epoch < v.withdrawable_epoch:
+                status = "exited_slashed" if v.slashed else "exited_unslashed"
+            else:
+                status = "withdrawal_possible"
+            out.append({
+                "index": str(i), "balance": str(int(st.balances[i])),
+                "status": status,
+                "validator": {
+                    "pubkey": "0x" + v.pubkey.hex(),
+                    "withdrawal_credentials":
+                        "0x" + v.withdrawal_credentials.hex(),
+                    "effective_balance": str(v.effective_balance),
+                    "slashed": v.slashed,
+                    "activation_eligibility_epoch":
+                        str(v.activation_eligibility_epoch),
+                    "activation_epoch": str(v.activation_epoch),
+                    "exit_epoch": str(v.exit_epoch),
+                    "withdrawable_epoch": str(v.withdrawable_epoch),
+                }})
+        return out
+
+    def block_header(self, block_id: str) -> dict:
+        root, blk = self._resolve_block(block_id)
+        h = blk.message
+        return {"root": "0x" + root.hex(),
+                "canonical": self.chain.block_root_at_slot(h.slot) == root,
+                "header": {"message": {
+                    "slot": str(h.slot),
+                    "proposer_index": str(h.proposer_index),
+                    "parent_root": "0x" + h.parent_root.hex(),
+                    "state_root": "0x" + h.state_root.hex(),
+                    "body_root": "0x" + htr(h.body).hex()},
+                    "signature": "0x" + blk.signature.hex()}}
+
+    def _resolve_block(self, block_id: str):
+        chain = self.chain
+        if block_id == "head":
+            head = chain.head()
+            return head.head_block_root, head.head_block
+        if block_id == "genesis":
+            root = chain.genesis_block_root
+        elif block_id == "finalized":
+            root = chain.finalized_checkpoint()[1]
+        elif block_id.startswith("0x"):
+            root = bytes.fromhex(block_id[2:])
+        else:
+            try:
+                root = chain.block_root_at_slot(int(block_id))
+            except ValueError:
+                raise ApiError(400, f"bad block id {block_id}")
+        if root is None:
+            raise ApiError(404, "unknown block")
+        blk = chain.store.get_block(root)
+        if blk is None:
+            raise ApiError(404, "block not found")
+        return root, blk
+
+    def block_ssz(self, block_id: str) -> bytes:
+        from ..ssz import serialize
+        _root, blk = self._resolve_block(block_id)
+        return serialize(type(blk).ssz_type, blk)
+
+    def publish_block(self, signed_block) -> None:
+        from ..chain.errors import BlockError
+        try:
+            self.chain.process_block(signed_block)
+        except BlockError as e:
+            raise ApiError(400, f"block rejected: {e}")
+
+    # -- validator duties ----------------------------------------------------
+
+    def _duties_state(self, epoch: int):
+        st = self.chain.head().head_state
+        target = compute_start_slot_at_epoch(
+            epoch, self.chain.spec.preset.slots_per_epoch)
+        if st.slot < target:
+            st = st.copy()
+            process_slots(st, target)
+        return st
+
+    def get_proposer_duties(self, epoch: int) -> list[tuple[int, int]]:
+        st = self._duties_state(epoch)
+        spe = self.chain.spec.preset.slots_per_epoch
+        start = compute_start_slot_at_epoch(epoch, spe)
+        out = []
+        for slot in range(start, start + spe):
+            if slot == 0:
+                continue
+            out.append((slot, get_beacon_proposer_index(st, slot)))
+        return out
+
+    def get_attester_duties(self, epoch: int, indices: list[int]) -> list:
+        st = self._duties_state(epoch)
+        cache = committee_cache(st, epoch)
+        wanted = set(indices)
+        out = []
+        spe = self.chain.spec.preset.slots_per_epoch
+        start = compute_start_slot_at_epoch(epoch, spe)
+        for slot in range(start, start + spe):
+            for ci in range(cache.committees_per_slot):
+                committee = cache.committee(slot, ci)
+                for pos, v in enumerate(committee):
+                    if int(v) in wanted:
+                        out.append((slot, ci, int(v), len(committee), pos))
+        return out
+
+    def get_validator_index(self, pubkey: bytes) -> int | None:
+        return self.chain.head().head_state.validators.index_of(pubkey)
+
+    def produce_block(self, slot: int, randao_reveal: bytes):
+        block, _post = self.chain.produce_block(randao_reveal, slot)
+        return block
+
+    def attestation_data(self, slot: int, committee_index: int):
+        chain = self.chain
+        head = chain.head()
+        st = head.head_state
+        if st.slot < slot:
+            st = st.copy()
+            process_slots(st, slot)
+        T = chain.T
+        spe = chain.spec.preset.slots_per_epoch
+        epoch = compute_epoch_at_slot(slot, spe)
+        epoch_start = compute_start_slot_at_epoch(epoch, spe)
+        if head.head_state.slot <= epoch_start:
+            target_root = head.head_block_root
+        else:
+            target_root = st.get_block_root_at_slot(epoch_start)
+        return T.AttestationData(
+            slot=slot, index=committee_index,
+            beacon_block_root=head.head_block_root,
+            source=st.current_justified_checkpoint,
+            target=T.Checkpoint(epoch=epoch, root=target_root))
+
+    def publish_attestation(self, attestation) -> None:
+        from ..chain.errors import AttestationError
+        try:
+            v = self.chain.verify_unaggregated_attestation_for_gossip(
+                attestation)
+            self.chain.apply_attestation_to_fork_choice(v)
+            self.chain.add_to_op_pool(v)
+        except AttestationError as e:
+            if e.kind != "prior_attestation_known":
+                raise ApiError(400, f"attestation rejected: {e}")
+
+    def get_aggregate(self, slot: int, committee_index: int):
+        """Best pool aggregate for (slot, committee)."""
+        with self.chain.op_pool._lock:
+            best, best_count = None, -1
+            for bucket in self.chain.op_pool._attestations.values():
+                for a in bucket:
+                    if a.data.slot == slot and a.data.index == \
+                            committee_index:
+                        c = sum(1 for b in a.aggregation_bits if b)
+                        if c > best_count:
+                            best, best_count = a, c
+        return best
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        from ..chain.errors import AttestationError
+        try:
+            v = self.chain.verify_aggregated_attestation_for_gossip(
+                signed_aggregate)
+            self.chain.apply_attestation_to_fork_choice(v)
+            self.chain.add_to_op_pool(v)
+        except AttestationError as e:
+            if e.kind not in ("prior_attestation_known",):
+                raise ApiError(400, f"aggregate rejected: {e}")
+
+    def head_fork_version(self) -> bytes:
+        return self.chain.head().head_state.fork.current_version
+
+    def seen_liveness(self, indices: list[int], epoch: int) -> list[bool]:
+        return [self.chain.observed_attesters.has_been_observed(epoch, i)
+                for i in indices]

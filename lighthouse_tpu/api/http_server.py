@@ -1,0 +1,146 @@
+"""Eth Beacon-API HTTP server (stdlib ThreadingHTTPServer).
+
+Equivalent of the warp router in /root/reference/beacon_node/http_api/src/
+lib.rs (the most-used subset of the ~300 routes, incl. SSE events and
+/lighthouse extensions). JSON bodies; SSZ via Accept: application/octet-stream
+on block routes.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..ssz import deserialize
+from .backend import ApiBackend, ApiError
+
+
+class BeaconApiServer:
+    def __init__(self, backend: ApiBackend, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.backend = backend
+        handler = _make_handler(backend)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _make_handler(backend: ApiBackend):
+    routes_get = [
+        (re.compile(r"^/eth/v1/beacon/genesis$"),
+         lambda m, q: {"data": backend.genesis()}),
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/root$"),
+         lambda m, q: {"data": {"root": "0x" + backend.state_root(m[1]).hex()}}),
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/fork$"),
+         lambda m, q: {"data": backend.state_fork(m[1])}),
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/finality_checkpoints$"),
+         lambda m, q: {"data": backend.finality_checkpoints(m[1])}),
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/validators$"),
+         lambda m, q: {"data": backend.validators(
+             m[1], [int(i) for i in q.get("id", [])] or None)}),
+        (re.compile(r"^/eth/v1/beacon/headers/([^/]+)$"),
+         lambda m, q: {"data": backend.block_header(m[1])}),
+        (re.compile(r"^/eth/v1/node/health$"), lambda m, q: {}),
+        (re.compile(r"^/eth/v1/node/version$"),
+         lambda m, q: {"data": backend.version()}),
+        (re.compile(r"^/eth/v1/node/syncing$"),
+         lambda m, q: {"data": backend.syncing()}),
+        (re.compile(r"^/eth/v1/validator/duties/proposer/(\d+)$"),
+         lambda m, q: {"data": [
+             {"slot": str(s), "validator_index": str(v), "pubkey": "0x00"}
+             for s, v in backend.get_proposer_duties(int(m[1]))]}),
+        (re.compile(r"^/lighthouse/health$"),
+         lambda m, q: {"data": {"healthy": backend.is_healthy()}}),
+        (re.compile(r"^/lighthouse/syncing$"),
+         lambda m, q: {"data": backend.syncing()}),
+    ]
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _json(self, status: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            # SSE events stream
+            if url.path == "/eth/v1/events":
+                kinds = q.get("topics", ["head"])
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                sub = backend.chain.events.subscribe(kinds)
+                try:
+                    while True:
+                        kind, payload = sub.get(timeout=30)
+                        data = json.dumps(
+                            {k: (v.hex() if isinstance(v, bytes) else v)
+                             for k, v in payload.items()})
+                        self.wfile.write(
+                            f"event: {kind}\ndata: {data}\n\n".encode())
+                        self.wfile.flush()
+                except Exception:
+                    backend.chain.events.unsubscribe(sub)
+                return
+            if url.path.startswith("/eth/v2/beacon/blocks/"):
+                block_id = url.path.rsplit("/", 1)[1]
+                try:
+                    raw = backend.block_ssz(block_id)
+                except ApiError as e:
+                    return self._json(e.status, {"message": str(e)})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+                return
+            for pat, fn in routes_get:
+                m = pat.match(url.path)
+                if m:
+                    try:
+                        return self._json(200, fn(m, q))
+                    except ApiError as e:
+                        return self._json(e.status, {"message": str(e)})
+                    except Exception as e:
+                        return self._json(500, {"message": repr(e)})
+            self._json(404, {"message": "route not found"})
+
+        def do_POST(self):
+            url = urlparse(self.path)
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                if url.path == "/eth/v1/beacon/blocks":
+                    chain = backend.chain
+                    fork = chain.spec.fork_name_at_slot(chain.slot())
+                    cls = chain.T.SignedBeaconBlock[fork]
+                    signed = deserialize(cls.ssz_type, body)
+                    backend.publish_block(signed)
+                    return self._json(200, {})
+                return self._json(404, {"message": "route not found"})
+            except ApiError as e:
+                return self._json(e.status, {"message": str(e)})
+            except Exception as e:
+                return self._json(400, {"message": repr(e)})
+
+    return Handler

@@ -1,0 +1,79 @@
+"""Prometheus metrics (lighthouse_metrics + http_metrics equivalent).
+
+A global registry with the reference's metric-name conventions; scrape server
+on demand. Uses prometheus_client (baked in)."""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+try:
+    from prometheus_client import (
+        CollectorRegistry, Counter, Gauge, Histogram, generate_latest,
+    )
+    _HAVE_PROM = True
+except Exception:  # pragma: no cover
+    _HAVE_PROM = False
+
+REGISTRY = CollectorRegistry() if _HAVE_PROM else None
+_metrics: dict[str, object] = {}
+_lock = threading.Lock()
+
+
+def _get(kind, name: str, help_: str, **kw):
+    with _lock:
+        m = _metrics.get(name)
+        if m is None and _HAVE_PROM:
+            m = kind(name, help_, registry=REGISTRY, **kw)
+            _metrics[name] = m
+        return m
+
+
+def inc_counter(name: str, help_: str = "", amount: float = 1) -> None:
+    m = _get(Counter, name, help_ or name)
+    if m is not None:
+        m.inc(amount)
+
+
+def set_gauge(name: str, value: float, help_: str = "") -> None:
+    m = _get(Gauge, name, help_ or name)
+    if m is not None:
+        m.set(value)
+
+
+def observe(name: str, value: float, help_: str = "") -> None:
+    m = _get(Histogram, name, help_ or name)
+    if m is not None:
+        m.observe(value)
+
+
+class MetricsServer:
+    """/metrics scrape endpoint (beacon_node/http_metrics)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path != "/metrics" or not _HAVE_PROM:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = generate_latest(REGISTRY)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    def start(self):
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

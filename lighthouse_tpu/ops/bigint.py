@@ -1,0 +1,209 @@
+"""Batched 384-bit modular arithmetic for TPU (int32 limb vectors).
+
+The foundation of the BLS12-381 kernels (ops/bls12_381.py) — replaces blst's
+x86 assembly field arithmetic (SURVEY.md §2.6) with vector arithmetic over a
+batch dimension:
+
+- representation: 32 little-endian limbs of 12 bits in int32 ``[..., 32]``.
+  12-bit limbs keep schoolbook partial-product sums < 2^29, inside int32,
+  with no 64-bit emulation (TPU-friendly).
+- field values live in the *redundant* range [0, 2p) in Montgomery form
+  (R = 2^384); every op returns to [0, 2p), canonicalization only at the
+  edges. REDC bound: inputs < 2p => output < 2p.
+- all sequential pieces (carry propagation, conditional reduce) are
+  `lax.scan`s => small compiled graphs at any batch size.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 12
+NLIMBS = 32
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R_INT = 1 << (LIMB_BITS * NLIMBS)          # Montgomery radix 2^384
+R_MOD_P = R_INT % P_INT
+R2_MOD_P = (R_INT * R_INT) % P_INT
+NPRIME = (-pow(P_INT, -1, R_INT)) % R_INT  # -p^-1 mod R
+
+
+def to_limbs(v: int, n: int = NLIMBS) -> np.ndarray:
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = v & LIMB_MASK
+        v >>= LIMB_BITS
+    assert v == 0
+    return out
+
+
+def from_limbs(limbs) -> int:
+    v = 0
+    for i, l in enumerate(np.asarray(limbs).tolist()):
+        v += int(l) << (LIMB_BITS * i)
+    return v
+
+
+P_LIMBS = to_limbs(P_INT)
+TWO_P_LIMBS = to_limbs(2 * P_INT)
+NPRIME_LIMBS = to_limbs(NPRIME)
+R2_LIMBS = to_limbs(R2_MOD_P)
+R_LIMBS = to_limbs(R_MOD_P)
+ZERO_LIMBS = np.zeros(NLIMBS, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# carries
+# ---------------------------------------------------------------------------
+
+def normalize(x: jax.Array) -> jax.Array:
+    """Exact signed carry propagation over the last axis (lax.scan).
+
+    Input limbs may be any int32 (incl. negative); output limbs are in
+    [0, 2^12) except possibly a negative top limb iff the value is negative.
+    """
+    xt = jnp.moveaxis(x, -1, 0)  # [L, ...]
+
+    def step(carry, limb):
+        s = limb + carry
+        lo = s & LIMB_MASK
+        return s >> LIMB_BITS, lo
+
+    carry, lo = jax.lax.scan(step, jnp.zeros_like(xt[0]), xt)
+    out = jnp.moveaxis(lo, 0, -1)
+    # fold the final carry into the top limb so the sign is observable there
+    out = out.at[..., -1].add(carry << LIMB_BITS)
+    return out
+
+
+def is_negative(x_normalized: jax.Array) -> jax.Array:
+    return x_normalized[..., -1] < 0
+
+
+def cond_sub(x: jax.Array, m: np.ndarray) -> jax.Array:
+    """x - m if x >= m else x (x loose-positive, m constant)."""
+    d = normalize(x - jnp.asarray(m))
+    neg = is_negative(d)[..., None]
+    return jnp.where(neg, normalize(x), d)
+
+
+# ---------------------------------------------------------------------------
+# multiplication
+# ---------------------------------------------------------------------------
+
+_COL_IDX = np.clip(np.arange(2 * NLIMBS)[None, :] - np.arange(NLIMBS)[:, None],
+                   0, NLIMBS - 1)                       # [32, 64]: k - i
+_COL_VALID = ((np.arange(2 * NLIMBS)[None, :] - np.arange(NLIMBS)[:, None] >= 0)
+              & (np.arange(2 * NLIMBS)[None, :]
+                 - np.arange(NLIMBS)[:, None] < NLIMBS)).astype(np.int32)
+
+
+def _mul_columns(a: jax.Array, b: jax.Array, out_len: int) -> jax.Array:
+    """Schoolbook column products: out[k] = sum_i a[i] * b[k-i], un-carried.
+
+    One gather (Toeplitz expansion of b) + one contraction — a compact graph
+    (the unrolled slice-update form blew up compile times inside scans) that
+    XLA lowers to a batched matvec.
+    """
+    bmat = b[..., _COL_IDX] * _COL_VALID                # [..., 32, out]
+    out = jnp.einsum("...i,...ik->...k", a, bmat[..., :out_len],
+                     preferred_element_type=jnp.int32)
+    return out
+
+
+def mul_wide(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Full product in 64 carried limbs (inputs loose < 2^12+eps)."""
+    cols = _mul_columns(a, b, 2 * NLIMBS)
+    return normalize(cols)
+
+
+def mul_low(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Low 32 limbs of the product (mod R)."""
+    acc = _mul_columns(a, b, NLIMBS)
+    # carries mod R: drop overflow out of the top limb
+    out = normalize(acc)
+    return out.at[..., -1].set(out[..., -1] & LIMB_MASK)
+
+
+@jax.jit
+def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Montgomery product a*b*R^-1 mod p, inputs/outputs in [0, 2p)."""
+    t = _mul_columns(a, b, 2 * NLIMBS)            # un-carried columns
+    t = normalize(t)                               # exact 64-limb carry
+    t_lo = t[..., :NLIMBS]
+    m = mul_low(t_lo, jnp.asarray(NPRIME_LIMBS))
+    mp = _mul_columns(m, jnp.asarray(P_LIMBS), 2 * NLIMBS)
+    s = normalize(t + mp)
+    # low half of s is zero by construction; take the high half
+    return s[..., NLIMBS:]
+
+
+def mont_from_int_limbs(x: jax.Array) -> jax.Array:
+    """Into Montgomery domain: x * R mod p (x < p)."""
+    return mont_mul(x, jnp.asarray(R2_LIMBS))
+
+
+def mont_to_int_limbs(x: jax.Array) -> jax.Array:
+    """Out of Montgomery domain and fully reduced to [0, p)."""
+    one = jnp.zeros_like(x).at[..., 0].set(1)
+    v = mont_mul(x, one)
+    v = cond_sub(v, P_LIMBS)
+    return cond_sub(v, P_LIMBS)
+
+
+# ---------------------------------------------------------------------------
+# add/sub in [0, 2p)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def add_mod(a: jax.Array, b: jax.Array) -> jax.Array:
+    return cond_sub(a + b, TWO_P_LIMBS)
+
+
+@jax.jit
+def sub_mod(a: jax.Array, b: jax.Array) -> jax.Array:
+    return cond_sub(a - b + jnp.asarray(TWO_P_LIMBS), TWO_P_LIMBS)
+
+
+def neg_mod(a: jax.Array) -> jax.Array:
+    return sub_mod(jnp.zeros_like(a), a)
+
+
+def canonical(x: jax.Array) -> jax.Array:
+    """Reduce [0,2p) Montgomery-free value to [0,p)."""
+    return cond_sub(normalize(x), P_LIMBS)
+
+
+def eq_mod(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Equality of field values in [0,2p) (canonicalize then compare)."""
+    ca = canonical(a)
+    cb = canonical(b)
+    return jnp.all(ca == cb, axis=-1)
+
+
+def is_zero_mod(a: jax.Array) -> jax.Array:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# generic reduction (for hash_to_field: 512-bit -> Fp)
+# ---------------------------------------------------------------------------
+
+def reduce_wide_mod_p(wide: jax.Array) -> jax.Array:
+    """Reduce a 64-limb (768-bit capacity) value mod p into Montgomery form.
+
+    wide = hi*R + lo  =>  x mod p = REDC(hi * (R^2 mod p)) ... simpler:
+    interpret via two Montgomery steps: mont(lo, R2) + mont(hi, R2*R mod p
+    pre-multiplied) — we just use: x*R = lo*R + hi*R^2, so
+    mont(lo,R2) = lo*R, mont(hi, R3) ... computed with R3 constant.
+    Returns x*R mod p (Montgomery form), in [0, 2p).
+    """
+    r3 = to_limbs((R_INT * R_INT * R_INT) % P_INT)
+    lo = wide[..., :NLIMBS]
+    hi = wide[..., NLIMBS:]
+    return add_mod(mont_mul(lo, jnp.asarray(R2_LIMBS)),
+                   mont_mul(hi, jnp.asarray(r3)))

@@ -1,0 +1,563 @@
+"""Batched BLS12-381 tower/curve/pairing kernels for TPU.
+
+North star 1 (BASELINE.md): replace blst's multicore multi-pairing
+(crypto/bls/src/impls/blst.rs:37-119) with batch parallelism on the TPU
+vector unit. Built on ops/bigint (12-bit-limb Montgomery arithmetic).
+
+Shapes (leading dims are batch):
+  Fp   [..., 32]          Fp2  [..., 2, 32]
+  Fp6  [..., 3, 2, 32]    Fp12 [..., 2, 3, 2, 32]
+  G1 Jacobian (x, y, z) of Fp;  G2 of Fp2.
+
+Validated element-for-element against the pure-Python oracle
+(crypto/bls12_381) in tests/test_bls_kernel.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.bls12_381.fields import P as P_INT, X_PARAM
+from . import bigint as bi
+
+# ---------------------------------------------------------------------------
+# host <-> device conversion
+# ---------------------------------------------------------------------------
+
+
+def fp_encode(vals: list[int]) -> np.ndarray:
+    """Python ints -> Montgomery limb batch [n, 32]."""
+    arr = np.stack([bi.to_limbs(v % P_INT) for v in vals])
+    return np.asarray(bi.mont_from_int_limbs(arr))
+
+
+def fp_decode(arr) -> list[int]:
+    out = np.asarray(bi.mont_to_int_limbs(arr))
+    flat = out.reshape(-1, bi.NLIMBS)
+    return [bi.from_limbs(x) for x in flat]
+
+
+def fp2_encode(vals: list) -> np.ndarray:
+    """List of python Fp2 -> [n, 2, 32]."""
+    flat = []
+    for v in vals:
+        flat += [int(v.c0), int(v.c1)]
+    return fp_encode(flat).reshape(len(vals), 2, bi.NLIMBS)
+
+
+def fp_const(v: int) -> np.ndarray:
+    return fp_encode([v])[0]
+
+
+def fp2_const(c0: int, c1: int) -> np.ndarray:
+    return fp_encode([c0, c1]).reshape(2, bi.NLIMBS)
+
+
+FP_ZERO = np.zeros(bi.NLIMBS, np.int32)
+FP_ONE = fp_const(1)
+FP2_ZERO = np.zeros((2, bi.NLIMBS), np.int32)
+FP2_ONE = np.stack([FP_ONE, FP_ZERO])
+
+# ---------------------------------------------------------------------------
+# Fp wrappers
+# ---------------------------------------------------------------------------
+
+fp_add = bi.add_mod
+fp_sub = bi.sub_mod
+fp_mul = bi.mont_mul
+fp_neg = bi.neg_mod
+
+
+def fp_muln(a, k: int):
+    """Multiply by a small integer via additions."""
+    out = a
+    for _ in range(k - 1):
+        out = fp_add(out, a)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fp2 = Fp[u]/(u^2+1); element [..., 2, 32]
+# ---------------------------------------------------------------------------
+
+def fp2_add(a, b):
+    return bi.add_mod(a, b)
+
+
+def fp2_sub(a, b):
+    return bi.sub_mod(a, b)
+
+
+def fp2_neg(a):
+    return bi.neg_mod(a)
+
+
+def fp2_mul(a, b):
+    # Karatsuba's three Fp products run as ONE batched mont_mul (stacked
+    # along the Fp2 axis) — 3x smaller graphs inside scans, wider batches
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    lhs = jnp.stack([a0, a1, fp_add(a0, a1)], axis=-2)
+    rhs = jnp.stack([b0, b1, fp_add(b0, b1)], axis=-2)
+    t = fp_mul(lhs, rhs)
+    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+    c0 = fp_sub(t0, t1)
+    c1 = fp_sub(fp_sub(t2, t0), t1)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fp2_square(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    lhs = jnp.stack([fp_add(a0, a1), a0], axis=-2)
+    rhs = jnp.stack([fp_sub(a0, a1), a1], axis=-2)
+    t = fp_mul(lhs, rhs)
+    c0 = t[..., 0, :]
+    c1 = fp_muln(t[..., 1, :], 2)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fp2_mul_fp(a, s):
+    return jnp.stack([fp_mul(a[..., 0, :], s), fp_mul(a[..., 1, :], s)],
+                     axis=-2)
+
+
+def fp2_muln(a, k: int):
+    out = a
+    for _ in range(k - 1):
+        out = fp2_add(out, a)
+    return out
+
+
+def fp2_conj(a):
+    return jnp.stack([a[..., 0, :], fp_neg(a[..., 1, :])], axis=-2)
+
+
+def fp2_mul_by_xi(a):
+    """xi = 1 + u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([fp_sub(a0, a1), fp_add(a0, a1)], axis=-2)
+
+
+def fp2_eq(a, b):
+    return bi.eq_mod(a[..., 0, :], b[..., 0, :]) & \
+        bi.eq_mod(a[..., 1, :], b[..., 1, :])
+
+
+def fp2_is_zero(a):
+    return bi.is_zero_mod(a[..., 0, :]) & bi.is_zero_mod(a[..., 1, :])
+
+
+def scalars_to_bits(scalars: list[int], nbits: int) -> np.ndarray:
+    """Host-side: python ints -> MSB-first bit matrix [n, nbits] int32."""
+    out = np.zeros((len(scalars), nbits), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        for j in range(nbits):
+            out[i, nbits - 1 - j] = (s >> j) & 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fp6 = Fp2[v]/(v^3 - xi); element [..., 3, 2, 32]
+# ---------------------------------------------------------------------------
+
+def _f6(c0, c1, c2):
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def fp6_add(a, b):
+    return bi.add_mod(a, b)
+
+
+def fp6_sub(a, b):
+    return bi.sub_mod(a, b)
+
+
+def fp6_neg(a):
+    return bi.neg_mod(a)
+
+
+def fp6_mul(a, b):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
+    t0, t1, t2 = fp2_mul(a0, b0), fp2_mul(a1, b1), fp2_mul(a2, b2)
+    c0 = fp2_add(fp2_mul_by_xi(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)),
+        t0)
+    c1 = fp2_add(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1),
+        fp2_mul_by_xi(t2))
+    c2 = fp2_add(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2),
+        t1)
+    return _f6(c0, c1, c2)
+
+
+def fp6_mul_by_v(a):
+    return _f6(fp2_mul_by_xi(a[..., 2, :, :]), a[..., 0, :, :],
+               a[..., 1, :, :])
+
+
+# ---------------------------------------------------------------------------
+# Fp12 = Fp6[w]/(w^2 - v); element [..., 2, 3, 2, 32]
+# ---------------------------------------------------------------------------
+
+def _f12(c0, c1):
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def fp12_one_like(batch_shape) -> jnp.ndarray:
+    one = jnp.zeros(tuple(batch_shape) + (2, 3, 2, bi.NLIMBS),
+                    dtype=jnp.int32)
+    return one.at[..., 0, 0, :, :].set(jnp.asarray(FP2_ONE))
+
+
+def fp12_mul(a, b):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    return _f12(c0, c1)
+
+
+def fp12_square(a):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    t = fp6_mul(a0, a1)
+    c0 = fp6_sub(fp6_sub(
+        fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1))), t),
+        fp6_mul_by_v(t))
+    return _f12(c0, fp6_add(t, t))
+
+
+def fp12_conj(a):
+    return _f12(a[..., 0, :, :, :], fp6_neg(a[..., 1, :, :, :]))
+
+
+def fp12_mul_by_014(f, c0, c1, c4):
+    """Sparse multiply by (c0 + c1 v) + (c4 v) w — the Miller line shape."""
+    g = jnp.zeros_like(f)
+    g = g.at[..., 0, 0, :, :].set(c0)
+    g = g.at[..., 0, 1, :, :].set(c1)
+    g = g.at[..., 1, 1, :, :].set(c4)
+    return fp12_mul(f, g)
+
+
+def fp12_eq(a, b):
+    return jnp.all(
+        bi.eq_mod(a.reshape(a.shape[:-4] + (12, bi.NLIMBS)),
+                  b.reshape(b.shape[:-4] + (12, bi.NLIMBS))), axis=-1)
+
+
+# generic pow by a fixed integer exponent (scan over bits, MSB first)
+def fp12_pow_const(f, exponent: int):
+    bits = np.array([int(b) for b in bin(exponent)[2:]], dtype=np.int32)
+
+    def step(acc, bit):
+        acc = fp12_square(acc)
+        withf = fp12_mul(acc, f)
+        out = jnp.where(bit, withf, acc)
+        return out, None
+
+    init = fp12_one_like(f.shape[:-4])
+    # first bit is always 1: start from f
+    out, _ = jax.lax.scan(step, f, jnp.asarray(bits[1:]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fp inversion / exponentiation (scan)
+# ---------------------------------------------------------------------------
+
+def fp_pow_const(a, exponent: int):
+    bits = np.array([int(b) for b in bin(exponent)[2:]], dtype=np.int32)
+
+    def step(acc, bit):
+        acc = fp_mul(acc, acc)
+        witha = fp_mul(acc, a)
+        return jnp.where(bit, witha, acc), None
+
+    out, _ = jax.lax.scan(step, a, jnp.asarray(bits[1:]))
+    return out
+
+
+def fp_inv(a):
+    return fp_pow_const(a, P_INT - 2)
+
+
+def fp2_inv(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm = fp_add(fp_mul(a0, a0), fp_mul(a1, a1))
+    ninv = fp_inv(norm)
+    return jnp.stack([fp_mul(a0, ninv), fp_neg(fp_mul(a1, ninv))], axis=-2)
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    t0 = fp2_sub(fp2_square(a0), fp2_mul_by_xi(fp2_mul(a1, a2)))
+    t1 = fp2_sub(fp2_mul_by_xi(fp2_square(a2)), fp2_mul(a0, a1))
+    t2 = fp2_sub(fp2_square(a1), fp2_mul(a0, a2))
+    denom = fp2_add(fp2_mul(a0, t0),
+                    fp2_add(fp2_mul_by_xi(fp2_mul(a2, t1)),
+                            fp2_mul_by_xi(fp2_mul(a1, t2))))
+    dinv = fp2_inv(denom)
+    return _f6(fp2_mul(t0, dinv), fp2_mul(t1, dinv), fp2_mul(t2, dinv))
+
+
+def fp12_inv(a):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    t = fp6_inv(fp6_sub(fp6_mul(a0, a0), fp6_mul_by_v(fp6_mul(a1, a1))))
+    return _f12(fp6_mul(a0, t), fp6_neg(fp6_mul(a1, t)))
+
+
+# ---------------------------------------------------------------------------
+# G1 / G2 Jacobian point ops (infinity <=> z == 0)
+# ---------------------------------------------------------------------------
+
+def _make_point_ops(add_, sub_, mul_, square_, muln_, neg_, is_zero_, where_nd):
+    def dbl(x, y, z):
+        A = square_(x)
+        B = square_(y)
+        C = square_(B)
+        t = square_(add_(x, B))
+        D = muln_(sub_(sub_(t, A), C), 2)
+        E = muln_(A, 3)
+        F = square_(E)
+        X3 = sub_(F, muln_(D, 2))
+        Y3 = sub_(mul_(E, sub_(D, X3)), muln_(C, 8))
+        Z3 = muln_(mul_(y, z), 2)
+        return X3, Y3, Z3
+
+    def add(x1, y1, z1, x2, y2, z2):
+        inf1 = is_zero_(z1)
+        inf2 = is_zero_(z2)
+        Z1Z1 = square_(z1)
+        Z2Z2 = square_(z2)
+        U1 = mul_(x1, Z2Z2)
+        U2 = mul_(x2, Z1Z1)
+        S1 = mul_(y1, mul_(z2, Z2Z2))
+        S2 = mul_(y2, mul_(z1, Z1Z1))
+        H = sub_(U2, U1)
+        same_x = is_zero_(H)
+        same_y = is_zero_(sub_(S2, S1))
+        I = square_(muln_(H, 2))
+        J = mul_(H, I)
+        rr = muln_(sub_(S2, S1), 2)
+        V = mul_(U1, I)
+        X3 = sub_(sub_(square_(rr), J), muln_(V, 2))
+        Y3 = sub_(mul_(rr, sub_(V, X3)), muln_(mul_(S1, J), 2))
+        zz = square_(add_(z1, z2))
+        Z3 = mul_(sub_(sub_(zz, Z1Z1), Z2Z2), H)
+        # doubling / infinity handling
+        dx, dy, dz = dbl(x1, y1, z1)
+        use_dbl = same_x & same_y & ~inf1 & ~inf2
+        to_inf = same_x & ~same_y & ~inf1 & ~inf2
+        X3 = where_nd(use_dbl, dx, X3)
+        Y3 = where_nd(use_dbl, dy, Y3)
+        Z3 = where_nd(use_dbl, dz, Z3)
+        Z3 = where_nd(to_inf, jnp.zeros_like(Z3), Z3)
+        X3 = where_nd(inf1, x2, X3)
+        Y3 = where_nd(inf1, y2, Y3)
+        Z3 = where_nd(inf1, z2, Z3)
+        X3 = where_nd(inf2 & ~inf1, x1, X3)
+        Y3 = where_nd(inf2 & ~inf1, y1, Y3)
+        Z3 = where_nd(inf2 & ~inf1, z1, Z3)
+        return X3, Y3, Z3
+
+    def scalar_mul(x, y, z, bits: jax.Array):
+        """Per-element variable scalars as a bit matrix [n, nbits]
+        (MSB-first, int32 0/1 — avoids any int64 dependence). One lax.scan
+        of nbits steps, double-and-select-add."""
+        bits_t = jnp.moveaxis(jnp.asarray(bits, dtype=jnp.int32), -1, 0)
+
+        def step(carry, bit):
+            ax, ay, az = carry
+            ax, ay, az = dbl(ax, ay, az)
+            sx, sy, sz = add(ax, ay, az, x, y, z)
+            use = bit.astype(bool)
+            ax = where_nd(use, sx, ax)
+            ay = where_nd(use, sy, ay)
+            az = where_nd(use, sz, az)
+            return (ax, ay, az), None
+
+        zero = jnp.zeros_like(x)
+        init = (zero, zero, jnp.zeros_like(z))
+        (ax, ay, az), _ = jax.lax.scan(step, init, bits_t)
+        return ax, ay, az
+
+    def scalar_mul_const(x, y, z, k: int):
+        """Shared constant scalar (cofactor clearing, subgroup checks)."""
+        bits = np.array([int(b) for b in bin(k)[2:]], dtype=np.int32)
+
+        def step(carry, bit):
+            ax, ay, az = carry
+            ax, ay, az = dbl(ax, ay, az)
+            sx, sy, sz = add(ax, ay, az, x, y, z)
+            ax = where_nd(bit.astype(bool), sx, ax)
+            ay = where_nd(bit.astype(bool), sy, ay)
+            az = where_nd(bit.astype(bool), sz, az)
+            return (ax, ay, az), None
+
+        (ax, ay, az), _ = jax.lax.scan(
+            step, (x, y, jnp.zeros_like(z)), jnp.asarray(bits))
+        return ax, ay, az
+
+    return dbl, add, scalar_mul, scalar_mul_const
+
+
+def _where_fp(cond, a, b):
+    return jnp.where(cond[..., None], a, b)
+
+
+def _where_fp2(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def _fp_is_zero(a):
+    return bi.is_zero_mod(a)
+
+
+g1_dbl, g1_add, g1_scalar_mul, g1_scalar_mul_const = _make_point_ops(
+    fp_add, fp_sub, fp_mul, lambda a: fp_mul(a, a), fp_muln, fp_neg,
+    _fp_is_zero, _where_fp)
+
+g2_dbl, g2_add, g2_scalar_mul, g2_scalar_mul_const = _make_point_ops(
+    fp2_add, fp2_sub, fp2_mul, fp2_square, fp2_muln, fp2_neg,
+    fp2_is_zero, _where_fp2)
+
+
+def jacobian_to_affine_fp2(x, y, z):
+    zi = fp2_inv(z)
+    zi2 = fp2_square(zi)
+    return fp2_mul(x, zi2), fp2_mul(y, fp2_mul(zi2, zi))
+
+
+def jacobian_to_affine_fp(x, y, z):
+    zi = fp_inv(z)
+    zi2 = fp_mul(zi, zi)
+    return fp_mul(x, zi2), fp_mul(y, fp_mul(zi2, zi))
+
+
+# ---------------------------------------------------------------------------
+# Miller loop (batched pairs) + final exponentiation
+# ---------------------------------------------------------------------------
+
+_X_ABS = abs(X_PARAM)
+_X_BITS = np.array([int(b) for b in bin(_X_ABS)[2:]], dtype=np.int32)
+# constants precomputed at import (never inside a trace)
+_TWO_INV = fp_const(pow(2, P_INT - 2, P_INT))
+_B_TWIST_3 = fp2_const(12, 12)  # 3 * (4 + 4u)
+
+
+def _twist_b3():
+    return _B_TWIST_3
+
+
+def _miller_dbl_step(tx, ty, tz, two_inv):
+    a = fp2_mul_fp(fp2_mul(tx, ty), two_inv)
+    b = fp2_square(ty)
+    c = fp2_square(tz)
+    e = fp2_mul(jnp.asarray(_twist_b3()), c)
+    f = fp2_muln(e, 3)
+    g = fp2_mul_fp(fp2_add(b, f), two_inv)
+    h = fp2_sub(fp2_square(fp2_add(ty, tz)), fp2_add(b, c))
+    i = fp2_sub(e, b)
+    j = fp2_square(tx)
+    e_sq = fp2_square(e)
+    nx = fp2_mul(a, fp2_sub(b, f))
+    ny = fp2_sub(fp2_square(g), fp2_muln(e_sq, 3))
+    nz = fp2_mul(b, h)
+    return (nx, ny, nz), (i, fp2_muln(j, 3), fp2_neg(h))
+
+
+def _miller_add_step(tx, ty, tz, qx, qy):
+    theta = fp2_sub(ty, fp2_mul(qy, tz))
+    lam = fp2_sub(tx, fp2_mul(qx, tz))
+    c = fp2_square(theta)
+    d = fp2_square(lam)
+    e = fp2_mul(lam, d)
+    f = fp2_mul(tz, c)
+    g = fp2_mul(tx, d)
+    h = fp2_sub(fp2_add(e, f), fp2_muln(g, 2))
+    nx = fp2_mul(lam, h)
+    ny = fp2_sub(fp2_mul(theta, fp2_sub(g, h)), fp2_mul(e, ty))
+    nz = fp2_mul(tz, e)
+    j = fp2_sub(fp2_mul(theta, qx), fp2_mul(lam, qy))
+    return (nx, ny, nz), (j, fp2_neg(theta), lam)
+
+
+def _ell(f, coeffs, px, py):
+    c0, c1, c2 = coeffs
+    c2 = fp2_mul_fp(c2, py)
+    c1 = fp2_mul_fp(c1, px)
+    return fp12_mul_by_014(f, c0, c1, c2)
+
+
+@jax.jit
+def miller_loop_batch(px, py, qx, qy):
+    """f_i = miller(P_i, Q_i) for a batch of affine pairs.
+
+    px, py: Fp [n, 32]; qx, qy: Fp2 [n, 2, 32]. Returns Fp12 [n, ...].
+    The x-bit pattern is constant, so the loop is a lax.scan whose body
+    always computes the add-step and selects it in on set bits.
+    """
+    n = px.shape[0]
+    two_inv = jnp.asarray(_TWO_INV)
+    f = fp12_one_like((n,))
+    tx, ty, tz = qx, qy, jnp.broadcast_to(jnp.asarray(FP2_ONE), qx.shape)
+
+    bits = jnp.asarray(_X_BITS[1:])
+
+    def step(carry, bit):
+        f, tx, ty, tz = carry
+        f = fp12_square(f)
+        (tx, ty, tz), coeffs = _miller_dbl_step(tx, ty, tz, two_inv)
+        f = _ell(f, coeffs, px, py)
+        (ax, ay, az), acoeffs = _miller_add_step(tx, ty, tz, qx, qy)
+        fa = _ell(f, acoeffs, px, py)
+        use = bit.astype(bool)
+        f = jnp.where(use, fa, f)
+        tx = jnp.where(use, ax, tx)
+        ty = jnp.where(use, ay, ty)
+        tz = jnp.where(use, az, tz)
+        return (f, tx, ty, tz), None
+
+    (f, _, _, _), _ = jax.lax.scan(step, (f, tx, ty, tz), bits)
+    # x < 0: conjugate
+    return fp12_conj(f)
+
+
+def fp12_product(fs):
+    """Product over the batch axis (tree reduction)."""
+    n = fs.shape[0]
+    while n > 1:
+        if n % 2:
+            pad = fp12_one_like((1,))
+            fs = jnp.concatenate([fs, pad], axis=0)
+            n += 1
+        fs = fp12_mul(fs[: n // 2], fs[n // 2:])
+        n = n // 2
+    return fs[0]
+
+
+_HARD_EXP = (P_INT**4 - P_INT**2 + 1) // \
+    0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+
+@jax.jit
+def final_exponentiation(f):
+    """f^((p^12-1)/r) for a single Fp12 element [...]."""
+    f = fp12_mul(fp12_conj(f), fp12_inv(f))       # easy: f^(p^6-1)
+    f = fp12_mul(fp12_pow_const(f, P_INT * P_INT), f)  # easy: ^(p^2+1)
+    return fp12_pow_const(f, _HARD_EXP)           # hard part
+
+
+def pairing_check_batch(px, py, qx, qy) -> jax.Array:
+    """prod_i e(P_i, Q_i) == 1 (one shared final exponentiation)."""
+    fs = miller_loop_batch(px, py, qx, qy)
+    prod = fp12_product(fs)
+    out = final_exponentiation(prod)
+    return fp12_eq(out[None], fp12_one_like((1,)))[0]

@@ -1,0 +1,9 @@
+"""Slasher: surround/double-vote detection over a 2D chunked matrix.
+
+Equivalent of /root/reference/slasher (4.9k LoC): min/max-target chunk
+arrays per validator×epoch (array.rs:16-28), batched attestation queues,
+a KV backend (the native C++ store). The matrix update is embarrassingly
+array-parallel — implemented as vectorized numpy sweeps (the second TPU
+workload candidate, SURVEY.md §7 step 9).
+"""
+from .slasher import Slasher, SlasherConfig

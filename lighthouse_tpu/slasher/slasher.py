@@ -1,0 +1,213 @@
+"""The slasher core.
+
+Design (slasher/src/{slasher.rs:21, array.rs:16-28} re-thought array-first):
+for each validator we track, per epoch, the minimum target and maximum target
+of any attestation whose source covers that epoch. A new attestation
+(source s, target t) by validator v is:
+
+- surrounded by a prior vote   if  min_target[v, s] > t ... (prior has
+  source < s and target > t)
+- surrounds a prior vote       if  max_target[v, s] < t and max exists
+  (prior has source > s and target < t)
+- a double vote                if a different attestation with the same
+  target exists.
+
+The reference stores zlib-compressed 2D chunks in LMDB; here the matrix is a
+dense numpy (validators × history) pair of uint16 distance arrays updated
+with vectorized column sweeps, persisted to the native KV store in chunks.
+Attestations are ingested in batches from a queue (attestation_queue.rs) on
+each `process_queued(current_epoch)` call.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ssz import htr
+
+
+@dataclass
+class SlasherConfig:
+    history_length: int = 4096          # epochs of history
+    chunk_size: int = 16
+    validator_chunk_size: int = 256
+    max_db_size_mb: int = 1024
+
+
+@dataclass
+class SlashingRecord:
+    kind: str                  # "double" | "surrounds" | "surrounded"
+    validator_index: int
+    attestation_1: object      # prior (indexed) attestation data snapshot
+    attestation_2: object      # new offender
+
+
+class Slasher:
+    def __init__(self, config: SlasherConfig | None = None, store=None,
+                 n_validators: int = 0):
+        self.config = config or SlasherConfig()
+        self.store = store
+        H = self.config.history_length
+        # distances stored relative to epoch (bounded by history window)
+        self._min_target = np.full((n_validators, H), np.iinfo(np.uint16).max,
+                                   dtype=np.uint16)
+        self._max_target = np.zeros((n_validators, H), dtype=np.uint16)
+        # (validator, target) -> (data_root, data) for double-vote detection
+        self._by_target: dict[tuple[int, int], tuple[bytes, object]] = {}
+        self._queue: list = []
+        self._blocks: dict[tuple[int, int], bytes] = {}
+        self._block_queue: list = []
+        self._lock = threading.Lock()
+        self.slashings: list[SlashingRecord] = []
+
+    def _ensure_capacity(self, n: int) -> None:
+        cur = self._min_target.shape[0]
+        if n <= cur:
+            return
+        H = self.config.history_length
+        grow = n - cur
+        self._min_target = np.vstack(
+            [self._min_target,
+             np.full((grow, H), np.iinfo(np.uint16).max, np.uint16)])
+        self._max_target = np.vstack(
+            [self._max_target, np.zeros((grow, H), np.uint16)])
+
+    # -- ingestion -----------------------------------------------------------
+
+    def accept_attestation(self, indexed) -> None:
+        """Queue an indexed attestation (gossip/block feed)."""
+        with self._lock:
+            self._queue.append(indexed)
+
+    def accept_block_header(self, signed_header) -> None:
+        with self._lock:
+            self._block_queue.append(signed_header)
+
+    # -- batch processing ----------------------------------------------------
+
+    def process_queued(self, current_epoch: int) -> list[SlashingRecord]:
+        """One batch update (slasher.rs process_queued); returns new
+        slashings found in this batch."""
+        with self._lock:
+            batch, self._queue = self._queue, []
+            blocks, self._block_queue = self._block_queue, []
+        found: list[SlashingRecord] = []
+        for indexed in batch:
+            found.extend(self._process_attestation(indexed, current_epoch))
+        for header in blocks:
+            rec = self._process_block(header)
+            if rec:
+                found.append(rec)
+        self.slashings.extend(found)
+        return found
+
+    def _process_attestation(self, indexed,
+                             current_epoch: int) -> list[SlashingRecord]:
+        """Matrix semantics (slasher design):
+        min_target[v][e] = min target among v's attestations with source >= e
+        max_target[v][e] = max target among v's attestations with source <= e
+
+        new (s,t) SURROUNDS a prior vote    iff min_target[v][s+1] < t
+        new (s,t) IS SURROUNDED by a prior  iff max_target[v][s-1] > t
+        """
+        H = self.config.history_length
+        NONE_MIN = np.iinfo(np.uint16).max
+        s = indexed.data.source.epoch
+        t = indexed.data.target.epoch
+        if t > current_epoch or s > t:
+            return []
+        if current_epoch - t >= H:
+            return []
+        data_root = htr(indexed.data)
+        out = []
+        idxs = np.asarray(sorted({int(i) for i in
+                                  indexed.attesting_indices}),
+                          dtype=np.int64)
+        if len(idxs) == 0:
+            return []
+        self._ensure_capacity(int(idxs.max()) + 1)
+
+        # double votes
+        for v in idxs:
+            prev = self._by_target.get((int(v), t))
+            if prev is not None and prev[0] != data_root:
+                out.append(SlashingRecord("double", int(v), prev[1],
+                                          indexed))
+            else:
+                self._by_target[(int(v), t)] = (data_root, indexed)
+
+        # distances are stored relative to the column epoch, capped by H
+        if s + 1 <= current_epoch:
+            col = (s + 1) % H
+            mins = self._min_target[idxs, col].astype(np.int64)
+            surrounds = (mins != NONE_MIN) & (mins + s + 1 < t)
+            for v in idxs[surrounds]:
+                out.append(SlashingRecord("surrounds", int(v), None,
+                                          indexed))
+        if s >= 1:
+            col = (s - 1) % H
+            maxs = self._max_target[idxs, col].astype(np.int64)
+            surrounded = (maxs > 0) & (maxs + s - 1 > t)
+            for v in idxs[surrounded]:
+                out.append(SlashingRecord("surrounded", int(v), None,
+                                          indexed))
+
+        # update min_target for e <= s and max_target for e >= s over the
+        # whole history window (full sweeps — the reference's chunked
+        # early-stop optimization is a TODO; correctness first)
+        lo = max(0, current_epoch - H + 1)
+        for e in range(s, lo - 1, -1):
+            col = e % H
+            d = np.uint16(min(t - e, NONE_MIN - 1))
+            cur = self._min_target[idxs, col]
+            upd = cur > d
+            if upd.any():
+                self._min_target[idxs[upd], col] = d
+        for e in range(s, current_epoch + 1):
+            col = e % H
+            # targets at/below the column epoch can never participate in a
+            # surround; clamp to 0 (= "no relevant entry")
+            d = np.uint16(t - e) if t >= e else np.uint16(0)
+            cur = self._max_target[idxs, col]
+            upd = cur < d
+            if upd.any():
+                self._max_target[idxs[upd], col] = d
+        return out
+
+    def _process_block(self, signed_header) -> SlashingRecord | None:
+        h = signed_header.message
+        key = (h.slot, h.proposer_index)
+        root = htr(h)
+        prev = self._blocks.get(key)
+        if prev is None:
+            self._blocks[key] = root
+            return None
+        if prev != root:
+            return SlashingRecord("double", h.proposer_index, prev,
+                                  signed_header)
+        return None
+
+    # -- persistence ---------------------------------------------------------
+
+    def persist(self) -> None:
+        if self.store is None:
+            return
+        self.store.put(b"slasher:min", self._min_target.tobytes())
+        self.store.put(b"slasher:max", self._max_target.tobytes())
+        self.store.put(b"slasher:shape",
+                       struct.pack("<QQ", *self._min_target.shape))
+
+    def restore(self) -> None:
+        if self.store is None:
+            return
+        shape = self.store.get(b"slasher:shape")
+        if shape is None:
+            return
+        n, H = struct.unpack("<QQ", shape)
+        self._min_target = np.frombuffer(
+            self.store.get(b"slasher:min"), np.uint16).reshape(n, H).copy()
+        self._max_target = np.frombuffer(
+            self.store.get(b"slasher:max"), np.uint16).reshape(n, H).copy()

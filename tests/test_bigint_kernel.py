@@ -1,0 +1,93 @@
+"""Batched limb arithmetic vs Python big-int oracle."""
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ops import bigint as bi
+
+P = bi.P_INT
+rng = np.random.default_rng(11)
+
+
+def rand_batch(n, bound=P):
+    vals = [int(rng.integers(0, 2**62)) * int(rng.integers(0, 2**62))
+            % bound for _ in range(n)]
+    vals = [v * pow(2, i, bound) % bound for i, v in enumerate(vals)]
+    arr = np.stack([bi.to_limbs(v) for v in vals])
+    return vals, arr
+
+
+def test_limb_roundtrip():
+    v = P - 12345
+    assert bi.from_limbs(bi.to_limbs(v)) == v
+
+
+def test_normalize_signed():
+    x = np.zeros((2, bi.NLIMBS), np.int32)
+    x[0, 0] = (1 << 14) + 5       # overflowing limb
+    x[1, 0] = -3                  # negative value
+    out = np.asarray(bi.normalize(x))
+    assert bi.from_limbs(out[0]) == (1 << 14) + 5
+    assert out[1, -1] < 0         # negative detected at the top limb
+
+
+def test_mont_mul_matches_python():
+    n = 16
+    va, a = rand_batch(n)
+    vb, b = rand_batch(n)
+    am = np.asarray(bi.mont_from_int_limbs(a))
+    bm = np.asarray(bi.mont_from_int_limbs(b))
+    cm = bi.mont_mul(am, bm)
+    c = np.asarray(bi.mont_to_int_limbs(cm))
+    for i in range(n):
+        assert bi.from_limbs(c[i]) == va[i] * vb[i] % P, i
+
+
+def test_add_sub_neg():
+    n = 8
+    va, a = rand_batch(n)
+    vb, b = rand_batch(n)
+    am = np.asarray(bi.mont_from_int_limbs(a))
+    bm = np.asarray(bi.mont_from_int_limbs(b))
+    s = np.asarray(bi.mont_to_int_limbs(bi.add_mod(am, bm)))
+    d = np.asarray(bi.mont_to_int_limbs(bi.sub_mod(am, bm)))
+    ng = np.asarray(bi.mont_to_int_limbs(bi.neg_mod(am)))
+    for i in range(n):
+        assert bi.from_limbs(s[i]) == (va[i] + vb[i]) % P
+        assert bi.from_limbs(d[i]) == (va[i] - vb[i]) % P
+        assert bi.from_limbs(ng[i]) == (-va[i]) % P
+
+
+def test_eq_and_zero():
+    _va, a = rand_batch(4)
+    am = bi.mont_from_int_limbs(a)
+    am2 = bi.add_mod(am, np.zeros_like(np.asarray(am)))
+    assert bool(np.asarray(bi.eq_mod(am, am2)).all())
+    z = bi.sub_mod(am, am)
+    assert bool(np.asarray(bi.is_zero_mod(z)).all())
+
+
+def test_reduce_wide():
+    n = 6
+    vals = [int.from_bytes(rng.integers(0, 256, 64, dtype=np.uint8)
+                           .tobytes(), "big") for _ in range(n)]
+    wide = np.stack([bi.to_limbs(v, 2 * bi.NLIMBS) for v in vals])
+    m = bi.reduce_wide_mod_p(wide)
+    out = np.asarray(bi.mont_to_int_limbs(m))
+    for i in range(n):
+        assert bi.from_limbs(out[i]) == vals[i] % P
+
+
+def test_chained_muls_stay_bounded():
+    """Stress the [0,2p) invariant through a long mul/add chain."""
+    va, a = rand_batch(4)
+    x = bi.mont_from_int_limbs(a)
+    acc = x
+    expect = list(va)
+    for k in range(50):
+        acc = bi.mont_mul(acc, x)
+        acc = bi.add_mod(acc, x)
+        expect = [(e * v + v) % P for e, v in zip(expect, va)]
+        assert np.asarray(acc).max() < (1 << bi.LIMB_BITS) + 2
+    out = np.asarray(bi.mont_to_int_limbs(acc))
+    for i in range(4):
+        assert bi.from_limbs(out[i]) == expect[i]

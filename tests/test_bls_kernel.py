@@ -1,0 +1,135 @@
+"""TPU BLS12-381 kernels vs the pure-Python oracle."""
+import numpy as np
+import pytest
+
+import lighthouse_tpu.ops.bls12_381 as k
+from lighthouse_tpu.crypto.bls12_381 import (
+    Fp2, G1_GENERATOR, G2_GENERATOR, P, pairing, multi_pairing,
+    sk_to_pk, sign, keygen_interop, hash_to_g2,
+)
+from lighthouse_tpu.crypto.bls12_381.fields import Fp12
+from lighthouse_tpu.ops import bigint as bi
+
+rng = np.random.default_rng(21)
+
+
+def rand_fp2(n):
+    return [Fp2(int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % P,
+                int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % P)
+            for _ in range(n)]
+
+
+def test_fp2_mul_square_inv():
+    n = 8
+    a = rand_fp2(n)
+    b = rand_fp2(n)
+    ka, kb = k.fp2_encode(a), k.fp2_encode(b)
+    prod = k.fp2_mul(ka, kb)
+    sq = k.fp2_square(ka)
+    inv = k.fp2_inv(ka)
+    for i in range(n):
+        want = a[i] * b[i]
+        got = k.fp_decode(prod[i])
+        assert got == [int(want.c0), int(want.c1)]
+        wsq = a[i].square()
+        assert k.fp_decode(sq[i]) == [int(wsq.c0), int(wsq.c1)]
+        winv = a[i].inv()
+        assert k.fp_decode(inv[i]) == [int(winv.c0), int(winv.c1)]
+
+
+def _encode_g2(points):
+    xs, ys = [], []
+    for p in points:
+        x, y = p.to_affine()
+        xs.append(x)
+        ys.append(y)
+    return k.fp2_encode(xs), k.fp2_encode(ys)
+
+
+def _encode_g1(points):
+    xs, ys = [], []
+    for p in points:
+        x, y = p.to_affine()
+        xs.append(int(x))
+        ys.append(int(y))
+    return k.fp_encode(xs), k.fp_encode(ys)
+
+
+def test_g1_scalar_mul_matches():
+    scalars = [3, 7, 65537, 2**63 - 25]
+    n = len(scalars)
+    x, y = _encode_g1([G1_GENERATOR] * n)
+    z = np.broadcast_to(k.FP_ONE, (n, bi.NLIMBS))
+    sx, sy, sz = k.g1_scalar_mul(x, y, z, k.scalars_to_bits(scalars, 64))
+    ax, ay = k.jacobian_to_affine_fp(sx, sy, sz)
+    for i, s in enumerate(scalars):
+        want = G1_GENERATOR.mul(s).to_affine()
+        assert k.fp_decode(ax[i])[0] == int(want[0])
+        assert k.fp_decode(ay[i])[0] == int(want[1])
+
+
+def test_g2_add_dbl_matches():
+    p2 = G2_GENERATOR.double()
+    p3 = p2.add(G2_GENERATOR)
+    x, y = _encode_g2([G2_GENERATOR, p2])
+    z = np.broadcast_to(k.FP2_ONE, (2, 2, bi.NLIMBS))
+    dx, dy, dz = k.g2_dbl(x, y, z)
+    ax, ay = k.jacobian_to_affine_fp2(dx, dy, dz)
+    want = p2.to_affine()
+    assert k.fp_decode(ax[0]) == [int(want[0].c0), int(want[0].c1)]
+    # add: G + 2G = 3G
+    sx, sy, sz = k.g2_add(x[:1], y[:1], z[:1], x[1:], y[1:], z[1:])
+    ax, ay = k.jacobian_to_affine_fp2(sx, sy, sz)
+    want3 = p3.to_affine()
+    assert k.fp_decode(ax[0]) == [int(want3[0].c0), int(want3[0].c1)]
+    assert k.fp_decode(ay[0]) == [int(want3[1].c0), int(want3[1].c1)]
+
+
+def _f12_to_ints(e):
+    out = []
+    for c6 in (e.c0, e.c1):
+        for c2 in (c6.c0, c6.c1, c6.c2):
+            out += [int(c2.c0), int(c2.c1)]
+    return out
+
+
+def test_miller_loop_matches_python():
+    """Miller loop only (final exp is covered by the slow test — its scans
+    take minutes on the CPU test backend but milliseconds per batch on TPU)."""
+    from lighthouse_tpu.crypto.bls12_381.pairing import miller_loop
+    pairs = [(G1_GENERATOR.mul(3), G2_GENERATOR.mul(5)),
+             (G1_GENERATOR.mul(2), G2_GENERATOR.mul(9))]
+    px, py = _encode_g1([p for p, _ in pairs])
+    qx, qy = _encode_g2([q for _, q in pairs])
+    fs = k.miller_loop_batch(px, py, qx, qy)
+    prod = k.fp12_product(fs)
+    want = miller_loop(pairs)
+    assert k.fp_decode(prod) == _f12_to_ints(want)
+
+
+@pytest.mark.skipif("not __import__('os').environ.get('LHTPU_SLOW_TESTS')")
+def test_final_exp_matches_python():
+    pairs = [(G1_GENERATOR.mul(3), G2_GENERATOR.mul(5))]
+    px, py = _encode_g1([p for p, _ in pairs])
+    qx, qy = _encode_g2([q for _, q in pairs])
+    out = k.final_exponentiation(
+        k.fp12_product(k.miller_loop_batch(px, py, qx, qy)))
+    want = pairing(*pairs[0])
+    assert k.fp_decode(out) == _f12_to_ints(want)
+
+
+@pytest.mark.skipif("not __import__('os').environ.get('LHTPU_SLOW_TESTS')")
+def test_pairing_check_verifies_signature():
+    sk = keygen_interop(3)
+    pk = sk_to_pk(sk)
+    msg = b"\x5a" * 32
+    sig = sign(sk, msg)
+    h = hash_to_g2(msg)
+    # e(-g1, sig) * e(pk, h) == 1
+    px, py = _encode_g1([G1_GENERATOR.neg(), pk])
+    qx, qy = _encode_g2([sig, h])
+    assert bool(np.asarray(k.pairing_check_batch(px, py, qx, qy)))
+    # wrong message fails
+    h2 = hash_to_g2(b"\x5b" * 32)
+    qx2, qy2 = _encode_g2([sig, h2])
+    assert not bool(np.asarray(k.pairing_check_batch(px, py, qx2, qy2)))

@@ -1,0 +1,92 @@
+"""HTTP Beacon-API round-trips over a real socket (InteractiveTester style,
+http_api/tests/ in the reference)."""
+import http.client
+import json
+
+import pytest
+
+from lighthouse_tpu.api import ApiBackend, BeaconApiServer
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.ssz import serialize
+
+
+@pytest.fixture
+def server():
+    bls.set_backend("fake")
+    h = BeaconChainHarness(minimal_spec(), 64)
+    h.extend_chain(10)
+    srv = BeaconApiServer(ApiBackend(h.chain))
+    srv.start()
+    yield h, srv
+    srv.stop()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def test_routes(server):
+    h, srv = server
+    port = srv.port
+    status, body = _get(port, "/eth/v1/beacon/genesis")
+    assert status == 200
+    data = json.loads(body)["data"]
+    assert data["genesis_validators_root"] == \
+        "0x" + h.chain.genesis_validators_root.hex()
+
+    status, body = _get(port, "/eth/v1/beacon/states/head/root")
+    assert status == 200
+    assert json.loads(body)["data"]["root"].startswith("0x")
+
+    status, body = _get(port, "/eth/v1/beacon/states/head/finality_checkpoints")
+    assert status == 200
+
+    status, body = _get(port, "/eth/v1/beacon/states/head/validators?id=0&id=1")
+    assert status == 200
+    vals = json.loads(body)["data"]
+    assert len(vals) == 2 and vals[0]["status"] == "active_ongoing"
+
+    status, body = _get(port, "/eth/v1/node/syncing")
+    assert json.loads(body)["data"]["is_syncing"] is False
+
+    status, body = _get(port, "/eth/v1/beacon/headers/head")
+    hdr = json.loads(body)["data"]
+    assert hdr["canonical"] is True
+    assert int(hdr["header"]["message"]["slot"]) == 10
+
+    # block ssz download
+    status, body = _get(port, "/eth/v2/beacon/blocks/head")
+    assert status == 200 and len(body) > 100
+
+    # 404 on unknown
+    status, _ = _get(port, "/eth/v1/beacon/headers/0x" + "ab" * 32)
+    assert status == 404
+
+
+def test_publish_block_roundtrip(server):
+    h, srv = server
+    h.advance_slot()
+    signed, _post = h.produce_signed_block()
+    raw = serialize(type(signed).ssz_type, signed)
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    conn.request("POST", "/eth/v1/beacon/blocks", body=raw,
+                 headers={"Content-Type": "application/octet-stream"})
+    r = conn.getresponse()
+    assert r.status == 200, r.read()
+    r.read()
+    conn.close()
+    assert h.chain.head().head_state.slot == 11
+    # duplicate returns 200 (idempotent), bad block 400
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    conn.request("POST", "/eth/v1/beacon/blocks", body=raw[:-10] + b"\x00" * 10)
+    r = conn.getresponse()
+    assert r.status == 400
+    r.read()
+    conn.close()

@@ -1,0 +1,93 @@
+"""Slasher detection matrix tests (slasher/src tests style)."""
+import pytest
+
+from lighthouse_tpu.containers import get_types
+from lighthouse_tpu.slasher import Slasher, SlasherConfig
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.store import MemoryStore
+
+T = get_types(minimal_spec().preset)
+
+
+def att(indices, source, target, root=b"\x11" * 32):
+    return T.IndexedAttestation(
+        attesting_indices=indices,
+        data=T.AttestationData(
+            slot=target * 8, index=0, beacon_block_root=root,
+            source=T.Checkpoint(epoch=source, root=b"\x01" * 32),
+            target=T.Checkpoint(epoch=target, root=b"\x02" * 32)),
+        signature=b"\x00" * 96)
+
+
+def make():
+    return Slasher(SlasherConfig(history_length=64), n_validators=16)
+
+
+def test_double_vote_detected():
+    s = make()
+    s.accept_attestation(att([1, 2], 1, 3, root=b"\xaa" * 32))
+    s.process_queued(10)
+    assert s.slashings == []
+    s.accept_attestation(att([2, 5], 1, 3, root=b"\xbb" * 32))
+    found = s.process_queued(10)
+    assert len(found) == 1
+    assert found[0].kind == "double" and found[0].validator_index == 2
+
+
+def test_surround_detected():
+    s = make()
+    s.accept_attestation(att([7], 3, 4))
+    s.process_queued(10)
+    # new attestation (2, 6) surrounds (3, 4)
+    found = []
+    s.accept_attestation(att([7], 2, 6, root=b"\xcc" * 32))
+    found = s.process_queued(10)
+    assert any(r.kind == "surrounds" and r.validator_index == 7
+               for r in found)
+
+
+def test_surrounded_detected():
+    s = make()
+    s.accept_attestation(att([3], 1, 8))
+    s.process_queued(10)
+    # new attestation (2, 5) is surrounded by (1, 8)
+    s.accept_attestation(att([3], 2, 5, root=b"\xdd" * 32))
+    found = s.process_queued(10)
+    assert any(r.kind == "surrounded" and r.validator_index == 3
+               for r in found)
+
+
+def test_benign_votes_not_flagged():
+    s = make()
+    for e in range(1, 8):
+        s.accept_attestation(att([0, 1, 2], e, e + 1, root=bytes([e]) * 32))
+    found = s.process_queued(10)
+    assert found == []
+
+
+def test_proposer_equivocation():
+    s = make()
+    h1 = T.SignedBeaconBlockHeader(message=T.BeaconBlockHeader(
+        slot=9, proposer_index=4, parent_root=b"\x01" * 32,
+        state_root=b"\x02" * 32, body_root=b"\x03" * 32),
+        signature=b"\x00" * 96)
+    h2 = T.SignedBeaconBlockHeader(message=T.BeaconBlockHeader(
+        slot=9, proposer_index=4, parent_root=b"\x01" * 32,
+        state_root=b"\xff" * 32, body_root=b"\x03" * 32),
+        signature=b"\x00" * 96)
+    s.accept_block_header(h1)
+    s.accept_block_header(h2)
+    found = s.process_queued(2)
+    assert len(found) == 1 and found[0].kind == "double"
+
+
+def test_persistence_roundtrip():
+    store = MemoryStore()
+    s = Slasher(SlasherConfig(history_length=64), store=store,
+                n_validators=8)
+    s.accept_attestation(att([1], 3, 4))
+    s.process_queued(10)
+    s.persist()
+    s2 = Slasher(SlasherConfig(history_length=64), store=store)
+    s2.restore()
+    assert (s2._min_target == s._min_target).all()
