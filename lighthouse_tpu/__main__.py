@@ -1,0 +1,162 @@
+"""CLI (L10): `python -m lighthouse_tpu <subcommand>`.
+
+Equivalent of /root/reference/lighthouse/src/main.rs subcommand dispatch
+(:412-416): beacon_node, validator_client, account_manager, database_manager,
+plus lcli-style dev tools. Flags fold into typed configs
+(beacon_node/src/{cli,config}.rs).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="lighthouse_tpu",
+        description="TPU-native Ethereum consensus client")
+    parser.add_argument("--network", default="minimal",
+                        choices=["mainnet", "minimal"],
+                        help="baked-in network config")
+    parser.add_argument("--log-level", default="INFO")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    bn = sub.add_parser("beacon_node", aliases=["bn", "beacon"])
+    bn.add_argument("--datadir", default=None)
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--metrics", action="store_true")
+    bn.add_argument("--metrics-port", type=int, default=5054)
+    bn.add_argument("--port", type=int, default=9000,
+                    help="p2p listen port")
+    bn.add_argument("--boot-nodes", default="",
+                    help="comma-separated host:port list")
+    bn.add_argument("--slasher", action="store_true")
+    bn.add_argument("--crypto-backend", default="python",
+                    choices=["python", "fake", "tpu", "cpp"])
+    bn.add_argument("--interop-validators", type=int, default=0)
+    bn.add_argument("--genesis-time", type=int, default=None)
+    bn.add_argument("--checkpoint-state", default=None,
+                    help="SSZ state file for checkpoint sync")
+    bn.add_argument("--checkpoint-block", default=None)
+    bn.add_argument("--dump-config", action="store_true")
+
+    vc = sub.add_parser("validator_client", aliases=["vc"])
+    vc.add_argument("--beacon-nodes", default="http://127.0.0.1:5052")
+    vc.add_argument("--interop-validators", type=int, default=0)
+    vc.add_argument("--slashing-db", default=":memory:")
+
+    am = sub.add_parser("account_manager", aliases=["am", "account"])
+    am_sub = am.add_subparsers(dest="am_cmd", required=True)
+    am_new = am_sub.add_parser("validator_new")
+    am_new.add_argument("--count", type=int, default=1)
+    am_new.add_argument("--out", default="keystores")
+    am_new.add_argument("--password", default="")
+
+    dbm = sub.add_parser("database_manager", aliases=["db"])
+    dbm.add_argument("--datadir", required=True)
+    dbm_sub = dbm.add_subparsers(dest="db_cmd", required=True)
+    dbm_sub.add_parser("version")
+    dbm_sub.add_parser("inspect")
+    dbm_sub.add_parser("compact")
+
+    args = parser.parse_args(argv)
+
+    from .specs import mainnet_spec, minimal_spec
+    spec = mainnet_spec() if args.network == "mainnet" else minimal_spec()
+
+    if args.cmd in ("beacon_node", "bn", "beacon"):
+        return _run_beacon_node(spec, args)
+    if args.cmd in ("validator_client", "vc"):
+        return _run_validator_client(spec, args)
+    if args.cmd in ("account_manager", "am", "account"):
+        return _run_account_manager(spec, args)
+    if args.cmd in ("database_manager", "db"):
+        return _run_database_manager(spec, args)
+    return 1
+
+
+def _run_beacon_node(spec, args):
+    from .client import ClientBuilder, Environment
+    from .client.builder import ClientConfig
+    from .network import NetworkConfig
+
+    boot = []
+    for hp in filter(None, args.boot_nodes.split(",")):
+        host, _, port = hp.rpartition(":")
+        boot.append((host or "127.0.0.1", int(port)))
+    cfg = ClientConfig(
+        datadir=args.datadir, http_port=args.http_port,
+        metrics_enabled=args.metrics, metrics_port=args.metrics_port,
+        network=NetworkConfig(port=args.port, boot_nodes=boot),
+        slasher_enabled=args.slasher, crypto_backend=args.crypto_backend,
+        interop_validator_count=args.interop_validators,
+        genesis_time=args.genesis_time)
+    if args.checkpoint_state:
+        cfg.checkpoint_sync_state = open(args.checkpoint_state, "rb").read()
+        if args.checkpoint_block:
+            cfg.checkpoint_sync_block = \
+                open(args.checkpoint_block, "rb").read()
+    if args.dump_config:
+        out = dict(vars(cfg))
+        out["network"] = vars(cfg.network)
+        for k, v in out.items():
+            if isinstance(v, bytes):
+                out[k] = "0x" + v.hex()
+        print(json.dumps(out, default=str))
+        return 0
+    env = Environment(args.log_level)
+    client = ClientBuilder(spec, env).with_config(cfg).build()
+    env.log.info("beacon node up: http=%s p2p=%s",
+                 client.api_server.port if client.api_server else None,
+                 client.network.port)
+    reason = env.block_until_shutdown()
+    env.log.info("shutting down: %s", reason)
+    client.stop()
+    return 0
+
+
+def _run_validator_client(spec, args):
+    print("validator_client: HTTP-client mode lands with the eth2 HTTP "
+          "client (round 2); in-process VC is available via "
+          "lighthouse_tpu.validator_client", file=sys.stderr)
+    return 0
+
+
+def _run_account_manager(spec, args):
+    import os
+    from .crypto import bls
+    from .crypto.keystore import create_keystore
+    os.makedirs(args.out, exist_ok=True)
+    for i in range(args.count):
+        sk = bls.keygen_interop(i)
+        pk = bls.sk_to_pk(sk)
+        ks = create_keystore(sk, args.password.encode())
+        path = os.path.join(args.out, f"keystore-{pk.hex()[:12]}.json")
+        with open(path, "w") as f:
+            json.dump(ks, f, indent=2)
+        print(f"wrote {path}")
+    return 0
+
+
+def _run_database_manager(spec, args):
+    from .store import HotColdDB, NativeKvStore
+    import os
+    db = HotColdDB(NativeKvStore(os.path.join(args.datadir, "chain_db")),
+                   NativeKvStore(os.path.join(args.datadir, "freezer_db")),
+                   spec)
+    if args.db_cmd == "version":
+        print(json.dumps({"schema_version": db.schema_version()}))
+    elif args.db_cmd == "inspect":
+        print(json.dumps({"split_slot": db.split.slot,
+                          "hot_keys": len(db.hot) if hasattr(
+                              db.hot, "__len__") else -1}))
+    elif args.db_cmd == "compact":
+        db.hot.compact()
+        db.cold.compact()
+        print("compacted")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

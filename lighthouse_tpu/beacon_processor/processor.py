@@ -1,0 +1,167 @@
+"""The processor itself."""
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+class WorkType(enum.Enum):
+    # priority order (beacon_processor/src/lib.rs queue drain order)
+    CHAIN_SEGMENT_BACKFILL = 0
+    GOSSIP_BLOCK = 1
+    GOSSIP_BLOB_SIDECAR = 2
+    RPC_BLOCK = 3
+    CHAIN_SEGMENT = 4
+    GOSSIP_AGGREGATE = 5
+    GOSSIP_AGGREGATE_BATCH = 6
+    GOSSIP_ATTESTATION = 7
+    GOSSIP_ATTESTATION_BATCH = 8
+    STATUS = 9
+    GOSSIP_VOLUNTARY_EXIT = 10
+    GOSSIP_PROPOSER_SLASHING = 11
+    GOSSIP_ATTESTER_SLASHING = 12
+    API_REQUEST = 13
+
+
+#: queues drained in this order each scheduling round
+PRIORITY_ORDER = [
+    WorkType.GOSSIP_BLOCK, WorkType.GOSSIP_BLOB_SIDECAR, WorkType.RPC_BLOCK,
+    WorkType.CHAIN_SEGMENT, WorkType.STATUS, WorkType.GOSSIP_AGGREGATE,
+    WorkType.GOSSIP_ATTESTATION, WorkType.GOSSIP_VOLUNTARY_EXIT,
+    WorkType.GOSSIP_PROPOSER_SLASHING, WorkType.GOSSIP_ATTESTER_SLASHING,
+    WorkType.API_REQUEST, WorkType.CHAIN_SEGMENT_BACKFILL,
+]
+
+#: per-queue caps (scaled by validator count in the reference, lib.rs:97-130)
+DEFAULT_CAPS = {
+    WorkType.GOSSIP_ATTESTATION: 16384,
+    WorkType.GOSSIP_AGGREGATE: 4096,
+    WorkType.GOSSIP_BLOCK: 1024,
+    WorkType.GOSSIP_BLOB_SIDECAR: 1024,
+    WorkType.RPC_BLOCK: 1024,
+    WorkType.CHAIN_SEGMENT: 64,
+    WorkType.CHAIN_SEGMENT_BACKFILL: 64,
+}
+
+
+@dataclass
+class Work:
+    kind: WorkType
+    run: Callable[[], Any]
+    batchable_payload: Any = None  # set for attestation work, enables batching
+
+
+class BeaconProcessor:
+    """Manager + bounded blocking worker pool. Gossip attestation/aggregate
+    queues are drained opportunistically into batch work items
+    (lib.rs:561)."""
+
+    MAX_BATCH = 64
+
+    def __init__(self, num_workers: int = 4,
+                 batch_handler: Callable | None = None,
+                 aggregate_batch_handler: Callable | None = None):
+        self.queues: dict[WorkType, deque] = {w: deque() for w in WorkType}
+        self.caps = dict(DEFAULT_CAPS)
+        self.batch_handler = batch_handler
+        self.aggregate_batch_handler = aggregate_batch_handler
+        self._idle = threading.Semaphore(num_workers)
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._stop = False
+        self.num_workers = num_workers
+        self._manager = threading.Thread(target=self._run, daemon=True)
+        self.dropped = 0
+        self.processed = 0
+
+    def start(self) -> None:
+        self._manager.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._event.set()
+
+    def submit(self, work: Work) -> bool:
+        with self._lock:
+            q = self.queues[work.kind]
+            cap = self.caps.get(work.kind, 4096)
+            if len(q) >= cap:
+                # drop oldest (gossip) — lossy under overload by design
+                q.popleft()
+                self.dropped += 1
+            q.append(work)
+        self._event.set()
+        return True
+
+    def _next_work(self) -> Work | list[Work] | None:
+        with self._lock:
+            for kind in PRIORITY_ORDER:
+                q = self.queues[kind]
+                if not q:
+                    continue
+                if kind in (WorkType.GOSSIP_ATTESTATION,
+                            WorkType.GOSSIP_AGGREGATE) and len(q) > 1:
+                    batch = []
+                    while q and len(batch) < self.MAX_BATCH:
+                        batch.append(q.popleft())
+                    return batch
+                return q.popleft()
+        return None
+
+    def _run(self) -> None:
+        while not self._stop:
+            work = self._next_work()
+            if work is None:
+                self._event.wait(timeout=0.05)
+                self._event.clear()
+                continue
+            self._idle.acquire()
+            threading.Thread(target=self._execute, args=(work,),
+                             daemon=True).start()
+
+    def _execute(self, work) -> None:
+        try:
+            if isinstance(work, list):
+                kind = work[0].kind
+                handler = (self.batch_handler
+                           if kind == WorkType.GOSSIP_ATTESTATION
+                           else self.aggregate_batch_handler)
+                if handler is not None:
+                    handler([w.batchable_payload for w in work])
+                else:
+                    for w in work:
+                        w.run()
+                self.processed += len(work)
+            else:
+                work.run()
+                self.processed += 1
+        except Exception:
+            import logging
+            logging.getLogger("lighthouse_tpu.processor").exception(
+                "work item failed")
+        finally:
+            self._idle.release()
+            self._event.set()
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Test helper: block until all queues drained and workers idle."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                empty = all(not q for q in self.queues.values())
+            if empty:
+                got = 0
+                for _ in range(self.num_workers):
+                    if self._idle.acquire(timeout=0.2):
+                        got += 1
+                for _ in range(got):
+                    self._idle.release()
+                if got == self.num_workers:
+                    return True
+            time.sleep(0.01)
+        return False

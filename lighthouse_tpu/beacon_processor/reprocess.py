@@ -1,0 +1,48 @@
+"""Park-and-replay queue for early/unresolvable work.
+
+Equivalent of beacon_processor/src/work_reprocessing_queue.rs: early-arriving
+gossip (future-slot attestations/blocks) and attestations for unknown blocks
+are parked and re-enqueued when their slot arrives or their block is
+imported.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class ReprocessQueue:
+    def __init__(self, submit):
+        self._submit = submit                 # BeaconProcessor.submit
+        self._by_slot: dict[int, list] = defaultdict(list)
+        self._by_root: dict[bytes, list] = defaultdict(list)
+        self._lock = threading.Lock()
+        self.max_per_bucket = 1024
+
+    def park_until_slot(self, slot: int, work) -> None:
+        with self._lock:
+            bucket = self._by_slot[slot]
+            if len(bucket) < self.max_per_bucket:
+                bucket.append(work)
+
+    def park_until_block(self, block_root: bytes, work) -> None:
+        with self._lock:
+            bucket = self._by_root[block_root]
+            if len(bucket) < self.max_per_bucket:
+                bucket.append(work)
+
+    def on_slot(self, slot: int) -> int:
+        """Replay everything parked for slots <= slot."""
+        with self._lock:
+            due = [w for s in list(self._by_slot)
+                   if s <= slot for w in self._by_slot.pop(s)]
+        for w in due:
+            self._submit(w)
+        return len(due)
+
+    def on_block_imported(self, block_root: bytes) -> int:
+        with self._lock:
+            due = self._by_root.pop(block_root, [])
+        for w in due:
+            self._submit(w)
+        return len(due)

@@ -1,0 +1,169 @@
+"""Staged client builder + the per-slot notifier.
+
+Mirrors /root/reference/beacon_node/client/src/builder.rs stage order:
+store -> slasher -> beacon chain (genesis / checkpoint sync) -> execution
+layer -> slot clock -> network -> timer -> http api -> metrics -> notifier.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..api import ApiBackend, BeaconApiServer
+from ..api.metrics import MetricsServer, set_gauge
+from ..chain import BeaconChainBuilder
+from ..chain.execution import MockExecutionLayer
+from ..crypto import bls
+from ..network import NetworkConfig, NetworkService
+from ..slasher import Slasher, SlasherConfig
+from ..specs.chain_spec import ChainSpec
+from ..store import HotColdDB, MemoryStore, NativeKvStore
+from ..utils.slot_clock import SystemTimeSlotClock
+from .environment import Environment
+
+
+@dataclass
+class ClientConfig:
+    datadir: str | None = None
+    http_port: int = 5052
+    http_enabled: bool = True
+    metrics_port: int = 5054
+    metrics_enabled: bool = False
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    slasher_enabled: bool = False
+    crypto_backend: str = "python"
+    checkpoint_sync_state: bytes | None = None
+    checkpoint_sync_block: bytes | None = None
+    interop_validator_count: int = 0
+    genesis_time: int | None = None
+
+
+class Client:
+    def __init__(self):
+        self.chain = None
+        self.network: NetworkService | None = None
+        self.api_server: BeaconApiServer | None = None
+        self.metrics_server: MetricsServer | None = None
+        self.slasher: Slasher | None = None
+        self.env: Environment | None = None
+
+    def stop(self) -> None:
+        if self.api_server:
+            self.api_server.stop()
+        if self.metrics_server:
+            self.metrics_server.stop()
+        if self.network:
+            self.network.stop()
+
+
+class ClientBuilder:
+    def __init__(self, spec: ChainSpec, env: Environment | None = None):
+        self.spec = spec
+        self.env = env or Environment()
+        self.config = ClientConfig()
+
+    def with_config(self, config: ClientConfig) -> "ClientBuilder":
+        self.config = config
+        return self
+
+    def build(self) -> Client:
+        cfg = self.config
+        client = Client()
+        client.env = self.env
+        bls.set_backend(cfg.crypto_backend)
+
+        # store
+        if cfg.datadir:
+            os.makedirs(cfg.datadir, exist_ok=True)
+            store = HotColdDB(
+                NativeKvStore(os.path.join(cfg.datadir, "chain_db")),
+                NativeKvStore(os.path.join(cfg.datadir, "freezer_db")),
+                self.spec)
+        else:
+            store = HotColdDB(MemoryStore(), MemoryStore(), self.spec)
+
+        # beacon chain (genesis / checkpoint sync)
+        cb = BeaconChainBuilder(self.spec).store(store)
+        if cfg.checkpoint_sync_state is not None:
+            from ..containers import get_types
+            from ..containers.state import BeaconState
+            from ..specs.chain_spec import ForkName
+            raw = cfg.checkpoint_sync_state
+            state = BeaconState.from_ssz_bytes(
+                raw[1:], get_types(self.spec.preset), self.spec,
+                ForkName(raw[0]))
+            blk = None
+            if cfg.checkpoint_sync_block is not None:
+                from ..ssz import deserialize
+                braw = cfg.checkpoint_sync_block
+                T = get_types(self.spec.preset)
+                blk = deserialize(
+                    T.SignedBeaconBlock[ForkName(braw[0])].ssz_type,
+                    braw[1:])
+            cb.weak_subjectivity_anchor(state, blk)
+        elif cfg.interop_validator_count:
+            cb.interop_genesis(
+                [bls.keygen_interop(i)
+                 for i in range(cfg.interop_validator_count)],
+                genesis_time=cfg.genesis_time or int(time.time()))
+        else:
+            raise ValueError("no genesis source configured")
+        # no explicit slot clock: BeaconChainBuilder derives it from the
+        # genesis state's own genesis_time (a mismatch here broke
+        # checkpoint-sync slot math — review finding)
+        cb.execution_layer(MockExecutionLayer())
+        client.chain = cb.build()
+
+        # slasher
+        if cfg.slasher_enabled:
+            client.slasher = Slasher(SlasherConfig(),
+                                     n_validators=len(
+                                         client.chain.genesis_state.validators))
+
+        # network, fed through the priority beacon processor
+        from ..beacon_processor import BeaconProcessor
+        client.processor = BeaconProcessor(num_workers=os.cpu_count() or 4)
+        client.network = NetworkService(client.chain, cfg.network,
+                                        processor=client.processor)
+        client.network.start()
+
+        # http api + metrics
+        if cfg.http_enabled:
+            client.api_server = BeaconApiServer(
+                ApiBackend(client.chain), port=cfg.http_port)
+            client.api_server.start()
+        if cfg.metrics_enabled:
+            client.metrics_server = MetricsServer(port=cfg.metrics_port)
+            client.metrics_server.start()
+
+        # per-slot timer + notifier (timer/src/lib.rs + client/notifier.rs)
+        def timer():
+            chain = client.chain
+            log = self.env.log
+            last = -1
+            while not self.env.shutdown_requested():
+                slot = chain.slot()
+                if slot != last:
+                    last = slot
+                    chain.per_slot_task()
+                    if client.slasher is not None:
+                        client.slasher.process_queued(chain.epoch())
+                    head = chain.head()
+                    set_gauge("beacon_head_slot", head.head_state.slot)
+                    set_gauge("beacon_finalized_epoch",
+                              chain.finalized_checkpoint()[0])
+                    log.info(
+                        "slot %d | head %s @ %d | finalized epoch %d | "
+                        "peers %d", slot,
+                        head.head_block_root.hex()[:8],
+                        head.head_state.slot,
+                        chain.finalized_checkpoint()[0],
+                        len(client.network.peers.connected())
+                        if client.network else 0)
+                time.sleep(
+                    min(1.0, client.chain.slot_clock.duration_to_next_slot()
+                        + 0.05))
+        self.env.spawn(timer, "timer")
+        return client

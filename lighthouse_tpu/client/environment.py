@@ -1,0 +1,66 @@
+"""Runtime environment: task spawning with graceful shutdown.
+
+Equivalent of /root/reference/{common/task_executor, lighthouse/environment}:
+named daemon tasks, a shutdown signal every task can trigger, and
+block-until-shutdown for the binaries.
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+
+class RuntimeContext:
+    """Per-service context: child logger + executor (service_context)."""
+
+    def __init__(self, env: "Environment", name: str):
+        self.env = env
+        self.log = logging.getLogger(f"lighthouse_tpu.{name}")
+
+    def spawn(self, fn, name: str) -> threading.Thread:
+        return self.env.spawn(fn, name)
+
+
+class Environment:
+    def __init__(self, log_level: str = "INFO"):
+        logging.basicConfig(
+            level=getattr(logging, log_level.upper(), logging.INFO),
+            format="%(asctime)s %(levelname)-5s %(name)s: %(message)s")
+        self.log = logging.getLogger("lighthouse_tpu")
+        self._shutdown = threading.Event()
+        self.shutdown_reason: str | None = None
+        self._tasks: list[threading.Thread] = []
+
+    def service_context(self, name: str) -> RuntimeContext:
+        return RuntimeContext(self, name)
+
+    def spawn(self, fn, name: str) -> threading.Thread:
+        def wrapped():
+            try:
+                fn()
+            except Exception:
+                self.log.exception("task %s died", name)
+                self.shutdown("task failure: " + name)
+        t = threading.Thread(target=wrapped, name=name, daemon=True)
+        t.start()
+        self._tasks.append(t)
+        return t
+
+    def shutdown(self, reason: str) -> None:
+        self.shutdown_reason = reason
+        self._shutdown.set()
+
+    def shutdown_requested(self) -> bool:
+        return self._shutdown.is_set()
+
+    def block_until_shutdown(self) -> str:
+        try:
+            signal.signal(signal.SIGTERM,
+                          lambda *a: self.shutdown("SIGTERM"))
+            signal.signal(signal.SIGINT,
+                          lambda *a: self.shutdown("SIGINT"))
+        except ValueError:
+            pass  # not main thread
+        self._shutdown.wait()
+        return self.shutdown_reason or "unknown"

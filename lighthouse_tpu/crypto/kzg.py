@@ -1,0 +1,185 @@
+"""KZG polynomial commitments for EIP-4844 blobs.
+
+Equivalent of /root/reference/crypto/kzg (wrapper over c-kzg): blob ->
+commitment, opening proofs, single + batch verification — implemented on our
+own BLS12-381 (pairing check e(proof, [tau - z]_2) == e(C - [y]_1, g_2)).
+
+Trusted setup: the real ceremony file is not bundled (zero-egress image); a
+deterministic DEVNET setup derived from a public seed is generated on first
+use and is clearly INSECURE-FOR-PRODUCTION (anyone can recover tau). Load a
+real setup with `load_trusted_setup(points)` for mainnet use.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from .bls12_381 import (
+    G1_GENERATOR, G2_GENERATOR, g1_compress, g1_decompress, multi_pairing,
+)
+from .bls12_381.curve import B_G1, Point
+from .bls12_381.fields import R
+
+FIELD_ELEMENTS_PER_BLOB = 4096
+BYTES_PER_FIELD_ELEMENT = 32
+
+#: primitive root of unity of order 4096 in the scalar field
+_ROOT_OF_UNITY = pow(7, (R - 1) // FIELD_ELEMENTS_PER_BLOB, R)
+
+
+class KzgError(Exception):
+    pass
+
+
+class Kzg:
+    """One instance per trusted setup (kzg::Kzg, crypto/kzg/src/lib.rs:55)."""
+
+    def __init__(self, g1_points: list | None = None, tau_g2=None,
+                 devnet_size: int = 64):
+        if g1_points is None:
+            # INSECURE devnet setup: tau derived from a fixed public seed
+            tau = int.from_bytes(hashlib.sha256(
+                b"lighthouse-tpu-devnet-kzg-setup").digest(), "big") % R
+            self.size = devnet_size
+            self.g1 = [G1_GENERATOR.mul(pow(tau, i, R))
+                       for i in range(self.size)]
+            self.tau_g2 = G2_GENERATOR.mul(tau)
+            self.insecure = True
+        else:
+            self.g1 = g1_points
+            self.size = len(g1_points)
+            self.tau_g2 = tau_g2
+            self.insecure = False
+        self.domain = [pow(_ROOT_OF_UNITY, _brp(i, FIELD_ELEMENTS_PER_BLOB),
+                           R) for i in range(self.size)]
+
+    # -- polynomial helpers (evaluation form over the bit-reversed domain) ---
+
+    def _evals_from_blob(self, blob: bytes) -> list[int]:
+        n = len(blob) // BYTES_PER_FIELD_ELEMENT
+        if n > self.size:
+            raise KzgError(f"blob larger than setup ({n} > {self.size})")
+        out = []
+        for i in range(n):
+            v = int.from_bytes(
+                blob[i * 32:(i + 1) * 32], "big")
+            if v >= R:
+                raise KzgError("blob element not canonical")
+            out.append(v)
+        # pad to setup size with zeros
+        out += [0] * (self.size - n)
+        return out
+
+    def _coeffs(self, evals: list[int]) -> list[int]:
+        """Lagrange interpolation over the domain (O(n^2) reference path;
+        the batched TPU NTT is the planned fast path)."""
+        n = self.size
+        coeffs = [0] * n
+        for j, (xj, yj) in enumerate(zip(self.domain, evals)):
+            if yj == 0:
+                continue
+            # basis polynomial l_j via incremental products
+            num = [1]
+            denom = 1
+            for m, xm in enumerate(self.domain):
+                if m == j:
+                    continue
+                num = _poly_mul_linear(num, (-xm) % R)
+                denom = denom * ((xj - xm) % R) % R
+            dinv = pow(denom, R - 2, R)
+            scale = yj * dinv % R
+            for k, c in enumerate(num):
+                coeffs[k] = (coeffs[k] + c * scale) % R
+        return coeffs
+
+    def _commit_coeffs(self, coeffs: list[int]) -> Point:
+        acc = Point.infinity(B_G1)
+        for c, p in zip(coeffs, self.g1):
+            if c:
+                acc = acc.add(p.mul(c))
+        return acc
+
+    # -- public API (c-kzg surface) ------------------------------------------
+
+    def blob_to_kzg_commitment(self, blob: bytes) -> bytes:
+        return g1_compress(self._commit_coeffs(
+            self._coeffs(self._evals_from_blob(blob))))
+
+    def compute_kzg_proof(self, blob: bytes, z: int) -> tuple[bytes, int]:
+        """Proof that p(z) == y; returns (proof, y)."""
+        coeffs = self._coeffs(self._evals_from_blob(blob))
+        y = _poly_eval(coeffs, z)
+        # q(x) = (p(x) - y) / (x - z)
+        q = _poly_div_linear(coeffs, y, z)
+        return g1_compress(self._commit_coeffs(q)), y
+
+    def verify_kzg_proof(self, commitment: bytes, z: int, y: int,
+                         proof: bytes) -> bool:
+        c = g1_decompress(commitment)
+        w = g1_decompress(proof)
+        if c is None or w is None:
+            return False
+        # e(W, [tau]_2 - [z]_2) == e(C - [y]_1, g2)
+        tau_minus_z = self.tau_g2.add(G2_GENERATOR.mul(z).neg())
+        c_minus_y = c.add(G1_GENERATOR.mul(y).neg())
+        return multi_pairing([
+            (w, tau_minus_z),
+            (c_minus_y.neg(), G2_GENERATOR),
+        ]).is_one()
+
+    def compute_blob_kzg_proof(self, blob: bytes,
+                               commitment: bytes) -> bytes:
+        z = _challenge(blob, commitment)
+        proof, _y = self.compute_kzg_proof(blob, z)
+        return proof
+
+    def verify_blob_kzg_proof(self, blob: bytes, commitment: bytes,
+                              proof: bytes) -> bool:
+        z = _challenge(blob, commitment)
+        coeffs = self._coeffs(self._evals_from_blob(blob))
+        y = _poly_eval(coeffs, z)
+        return self.verify_kzg_proof(commitment, z, y, proof)
+
+    def verify_blob_kzg_proof_batch(self, blobs: list[bytes],
+                                    commitments: list[bytes],
+                                    proofs: list[bytes]) -> bool:
+        return all(self.verify_blob_kzg_proof(b, c, p)
+                   for b, c, p in zip(blobs, commitments, proofs))
+
+
+def _brp(i: int, n: int) -> int:
+    bits = n.bit_length() - 1
+    return int(bin(i)[2:].zfill(bits)[::-1], 2)
+
+
+def _poly_mul_linear(poly: list[int], c: int) -> list[int]:
+    """poly(x) * (x + c) mod R."""
+    out = [0] * (len(poly) + 1)
+    for i, a in enumerate(poly):
+        out[i] = (out[i] + a * c) % R
+        out[i + 1] = (out[i + 1] + a) % R
+    return out
+
+
+def _poly_eval(coeffs: list[int], x: int) -> int:
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % R
+    return acc
+
+
+def _poly_div_linear(coeffs: list[int], y: int, z: int) -> list[int]:
+    """(p(x) - y) / (x - z) via synthetic division (exact when p(z) == y)."""
+    n = len(coeffs)
+    q = [0] * (n - 1)
+    acc = 0
+    for i in range(n - 1, 0, -1):
+        acc = (coeffs[i] + z * acc) % R
+        q[i - 1] = acc
+    return q
+
+
+def _challenge(blob: bytes, commitment: bytes) -> int:
+    """Fiat-Shamir evaluation challenge (spec compute_challenge shape)."""
+    h = hashlib.sha256(b"LHTPU_KZG_CHALLENGE" + len(blob).to_bytes(8, "little")
+                       + blob + commitment).digest()
+    return int.from_bytes(h, "big") % R

@@ -1,0 +1,114 @@
+"""Gossip pubsub (gossipsub's role; flood-publish with dedup + validation).
+
+Topics mirror lighthouse_network/src/types/topics.rs:109: beacon_block,
+beacon_aggregate_and_proof, beacon_attestation_{subnet}, voluntary_exit,
+proposer_slashing, attester_slashing, sync_committee_{subnet},
+bls_to_execution_change, blob_sidecar_{i}. Message ids are content hashes
+(gossipsub v1.1 message-id) and each message is validated before forwarding
+(accept/ignore/reject -> peer scoring).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import zlib
+from collections import OrderedDict
+
+
+class Topic:
+    BLOCK = "beacon_block"
+    AGGREGATE = "beacon_aggregate_and_proof"
+    VOLUNTARY_EXIT = "voluntary_exit"
+    PROPOSER_SLASHING = "proposer_slashing"
+    ATTESTER_SLASHING = "attester_slashing"
+    BLS_CHANGE = "bls_to_execution_change"
+
+    @staticmethod
+    def attestation_subnet(subnet: int) -> str:
+        return f"beacon_attestation_{subnet}"
+
+    @staticmethod
+    def sync_subnet(subnet: int) -> str:
+        return f"sync_committee_{subnet}"
+
+    @staticmethod
+    def blob_sidecar(index: int) -> str:
+        return f"blob_sidecar_{index}"
+
+
+class GossipEngine:
+    """validator(topic, data) -> 'accept' | 'ignore' | 'reject'."""
+
+    GOSSIP_FRAME = 1
+    SEEN_CAP = 16384
+
+    def __init__(self, transport, fork_digest: bytes):
+        self.transport = transport
+        self.fork_digest = fork_digest
+        self.subscriptions: set[str] = set()
+        # validator returns (result, ctx); ctx is handed to on_message so the
+        # verified/deserialized object flows thread-locally (no shared state)
+        self.validator = lambda topic, data: ("accept", None)
+        self.on_message = lambda topic, data, peer, ctx: None
+        self.on_validation_result = lambda peer, topic, result: None
+        self._seen: OrderedDict[bytes, bool] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def subscribe(self, topic: str) -> None:
+        self.subscriptions.add(topic)
+
+    def unsubscribe(self, topic: str) -> None:
+        self.subscriptions.discard(topic)
+
+    def _message_id(self, topic: str, data: bytes) -> bytes:
+        return hashlib.sha256(self.fork_digest + topic.encode()
+                              + data).digest()[:20]
+
+    def _mark_seen(self, mid: bytes) -> bool:
+        with self._lock:
+            if mid in self._seen:
+                return True
+            self._seen[mid] = True
+            while len(self._seen) > self.SEEN_CAP:
+                self._seen.popitem(last=False)
+            return False
+
+    def publish(self, topic: str, data: bytes,
+                exclude_peer: str | None = None) -> int:
+        mid = self._message_id(topic, data)
+        self._mark_seen(mid)
+        msg = json.dumps({"topic": topic,
+                          "digest": self.fork_digest.hex()}).encode()
+        frame = len(msg).to_bytes(2, "little") + msg + zlib.compress(data)
+        sent = 0
+        for peer in list(self.transport.peers.values()):
+            if peer.node_id == exclude_peer:
+                continue
+            peer.send_frame(self.GOSSIP_FRAME, frame)
+            sent += 1
+        return sent
+
+    def handle_frame(self, peer, payload: bytes) -> None:
+        try:
+            hlen = int.from_bytes(payload[:2], "little")
+            head = json.loads(payload[2:2 + hlen])
+            data = zlib.decompress(payload[2 + hlen:])
+            topic = head["topic"]
+        except (ValueError, KeyError, zlib.error):
+            self.on_validation_result(peer, "?", "reject")
+            return
+        if head.get("digest") != self.fork_digest.hex():
+            self.on_validation_result(peer, topic, "reject")
+            return
+        if topic not in self.subscriptions:
+            return
+        mid = self._message_id(topic, data)
+        if self._mark_seen(mid):
+            return
+        result, ctx = self.validator(topic, data)
+        self.on_validation_result(peer, topic, result)
+        if result == "accept":
+            # forward to the mesh (flood) and deliver locally
+            self.publish(topic, data, exclude_peer=peer.node_id)
+            self.on_message(topic, data, peer, ctx)

@@ -1,0 +1,66 @@
+"""Peer scoring + lifecycle (peer_manager/peerdb/score.rs equivalent)."""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PeerInfo:
+    node_id: str
+    connected_at: float = field(default_factory=time.monotonic)
+    score: float = 0.0
+    status: object = None          # last StatusMessage
+    banned: bool = False
+
+
+class PeerManager:
+    BAN_THRESHOLD = -20.0
+    SCORES = {"reject": -5.0, "ignore": -0.5, "accept": 0.1,
+              "rate_limited": -1.0, "timeout": -2.0, "bad_segment": -10.0}
+
+    def __init__(self, target_peers: int = 16):
+        self.peers: dict[str, PeerInfo] = {}
+        self.target_peers = target_peers
+        self._lock = threading.Lock()
+        self.on_ban = lambda node_id: None
+
+    def on_connect(self, node_id: str) -> None:
+        with self._lock:
+            self.peers.setdefault(node_id, PeerInfo(node_id))
+
+    def on_disconnect(self, node_id: str) -> None:
+        with self._lock:
+            self.peers.pop(node_id, None)
+
+    def set_status(self, node_id: str, status) -> None:
+        with self._lock:
+            info = self.peers.get(node_id)
+            if info:
+                info.status = status
+
+    def report(self, node_id: str, event: str) -> None:
+        delta = self.SCORES.get(event, 0.0)
+        ban = False
+        with self._lock:
+            info = self.peers.get(node_id)
+            if info is None:
+                return
+            info.score += delta
+            if info.score < self.BAN_THRESHOLD and not info.banned:
+                info.banned = True
+                ban = True
+        if ban:
+            self.on_ban(node_id)
+
+    def connected(self) -> list[PeerInfo]:
+        with self._lock:
+            return [p for p in self.peers.values() if not p.banned]
+
+    def best_peer_for_sync(self) -> PeerInfo | None:
+        best, best_slot = None, -1
+        for p in self.connected():
+            if p.status is not None and p.status.head_slot > best_slot:
+                best, best_slot = p, p.status.head_slot
+        return best

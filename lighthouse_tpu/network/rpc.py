@@ -1,0 +1,141 @@
+"""Req/resp RPC (lighthouse_network/src/rpc: protocol.rs:236-266).
+
+Protocols: status, goodbye, ping, metadata, beacon_blocks_by_range,
+beacon_blocks_by_root. Payloads are zlib-compressed SSZ (the SSZ-snappy
+framing's role). Blocking request API with per-request ids + timeouts;
+token-bucket rate limiting per protocol (rpc/rate_limiter.rs).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+
+
+@dataclass
+class StatusMessage:
+    fork_digest: bytes
+    finalized_root: bytes
+    finalized_epoch: int
+    head_root: bytes
+    head_slot: int
+
+    def to_json(self) -> dict:
+        return {"fork_digest": self.fork_digest.hex(),
+                "finalized_root": self.finalized_root.hex(),
+                "finalized_epoch": self.finalized_epoch,
+                "head_root": self.head_root.hex(),
+                "head_slot": self.head_slot}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "StatusMessage":
+        return cls(bytes.fromhex(d["fork_digest"]),
+                   bytes.fromhex(d["finalized_root"]),
+                   int(d["finalized_epoch"]),
+                   bytes.fromhex(d["head_root"]), int(d["head_slot"]))
+
+
+class RateLimiter:
+    """Token bucket per (peer, protocol) (rpc/rate_limiter.rs)."""
+
+    LIMITS = {"beacon_blocks_by_range": (128, 10.0),
+              "beacon_blocks_by_root": (128, 10.0),
+              "status": (16, 10.0), "ping": (16, 10.0),
+              "metadata": (8, 10.0), "goodbye": (2, 10.0)}
+
+    def __init__(self):
+        self._buckets: dict[tuple, list] = {}
+        self._lock = threading.Lock()
+
+    def allow(self, peer_id: str, protocol: str, cost: int = 1) -> bool:
+        cap, window = self.LIMITS.get(protocol, (64, 10.0))
+        now = time.monotonic()
+        with self._lock:
+            tokens, ts = self._buckets.get((peer_id, protocol), (cap, now))
+            tokens = min(cap, tokens + (now - ts) * cap / window)
+            if tokens < cost:
+                self._buckets[(peer_id, protocol)] = (tokens, now)
+                return False
+            self._buckets[(peer_id, protocol)] = (tokens - cost, now)
+            return True
+
+
+class RpcHandler:
+    """Wire: frame kind 2 = request {id, protocol, payload}; kind 3 =
+    response {id, code, payload}. Handlers are registered per protocol."""
+
+    REQ_FRAME = 2
+    RESP_FRAME = 3
+
+    def __init__(self, transport):
+        self.transport = transport
+        self.handlers: dict[str, callable] = {}
+        self.rate_limiter = RateLimiter()
+        self.on_rate_limited = lambda peer, protocol: None
+        self._pending: dict[int, list] = {}
+        self._events: dict[int, threading.Event] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def register(self, protocol: str, handler) -> None:
+        """handler(peer, request_obj) -> response_obj (json-able)."""
+        self.handlers[protocol] = handler
+
+    def request(self, peer, protocol: str, payload: dict,
+                timeout: float = 10.0):
+        with self._lock:
+            self._next_id += 1
+            req_id = self._next_id
+            ev = threading.Event()
+            self._events[req_id] = ev
+        msg = zlib.compress(json.dumps(
+            {"id": req_id, "protocol": protocol,
+             "payload": payload}).encode())
+        peer.send_frame(self.REQ_FRAME, msg)
+        if not ev.wait(timeout):
+            with self._lock:
+                self._events.pop(req_id, None)
+                self._pending.pop(req_id, None)
+            raise TimeoutError(f"rpc {protocol} timed out")
+        with self._lock:
+            self._events.pop(req_id, None)
+            code, resp = self._pending.pop(req_id)
+        if code != 0:
+            raise RuntimeError(f"rpc error {code}: {resp}")
+        return resp
+
+    def handle_frame(self, peer, kind: int, payload: bytes) -> None:
+        try:
+            msg = json.loads(zlib.decompress(payload))
+        except (ValueError, zlib.error):
+            return
+        if not isinstance(msg, dict) or "id" not in msg:
+            return
+        if kind == self.REQ_FRAME:
+            protocol = msg.get("protocol", "?")
+            if not self.rate_limiter.allow(peer.node_id, protocol):
+                self.on_rate_limited(peer, protocol)
+                self._respond(peer, msg["id"], 429, "rate limited")
+                return
+            handler = self.handlers.get(protocol)
+            if handler is None:
+                self._respond(peer, msg["id"], 404, "unknown protocol")
+                return
+            try:
+                resp = handler(peer, msg.get("payload"))
+                self._respond(peer, msg["id"], 0, resp)
+            except Exception as e:
+                self._respond(peer, msg["id"], 500, repr(e))
+        elif kind == self.RESP_FRAME:
+            with self._lock:
+                ev = self._events.get(msg["id"])
+                if ev is not None:
+                    self._pending[msg["id"]] = (msg["code"], msg.get("payload"))
+                    ev.set()
+
+    def _respond(self, peer, req_id: int, code: int, payload) -> None:
+        msg = zlib.compress(json.dumps(
+            {"id": req_id, "code": code, "payload": payload}).encode())
+        peer.send_frame(self.RESP_FRAME, msg)

@@ -1,0 +1,278 @@
+"""NetworkService: wires transport/gossip/rpc/peers/sync to the chain.
+
+Equivalent of /root/reference/beacon_node/network/src/{service.rs:160,
+router.rs:33} + network_beacon_processor/{gossip_methods,rpc_methods}.rs:
+gossip is validated through the chain's gossip pipelines then imported;
+RPC serves blocks from the store; status exchange drives sync.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..chain.errors import AttestationError, BlockError
+from ..specs.chain_spec import compute_fork_digest
+from ..ssz import deserialize, htr, serialize
+from .gossip import GossipEngine, Topic
+from .peer_manager import PeerManager
+from .rpc import RpcHandler, StatusMessage
+from .sync import SyncManager, encode_block
+from .transport import Transport
+
+
+@dataclass
+class NetworkConfig:
+    host: str = "127.0.0.1"
+    port: int = 0
+    target_peers: int = 16
+    boot_nodes: list = None
+
+
+class NetworkService:
+    def __init__(self, chain, config: NetworkConfig | None = None,
+                 processor=None):
+        """`processor`: optional BeaconProcessor — accepted gossip is then
+        imported through its priority queues (with attestation batching)
+        instead of inline on the socket reader thread."""
+        self.chain = chain
+        self.config = config or NetworkConfig()
+        self.processor = processor
+        if processor is not None:
+            processor.batch_handler = self._attestation_batch
+            processor.start()
+        self.transport = Transport(self.config.host, self.config.port)
+        digest = compute_fork_digest(
+            chain.head().head_state.fork.current_version,
+            chain.genesis_validators_root)
+        self.gossip = GossipEngine(self.transport, digest)
+        self.rpc = RpcHandler(self.transport)
+        self.peers = PeerManager(self.config.target_peers)
+        self.sync = SyncManager(chain, self.rpc, self.peers)
+
+        self.transport.on_peer = self._on_peer
+        self.transport.on_frame = self._on_frame
+        self.transport.on_disconnect = \
+            lambda p: self.peers.on_disconnect(p.node_id)
+        self.gossip.validator = self._validate_gossip
+        self.gossip.on_message = self._deliver_gossip
+        self.gossip.on_validation_result = \
+            lambda peer, topic, result: self.peers.report(peer.node_id,
+                                                          result)
+        self.rpc.on_rate_limited = \
+            lambda peer, proto: self.peers.report(peer.node_id,
+                                                  "rate_limited")
+        self.peers.on_ban = self._ban
+
+        self.gossip.subscribe(Topic.BLOCK)
+        self.gossip.subscribe(Topic.AGGREGATE)
+        self.gossip.subscribe(Topic.VOLUNTARY_EXIT)
+        self.gossip.subscribe(Topic.PROPOSER_SLASHING)
+        self.gossip.subscribe(Topic.ATTESTER_SLASHING)
+        for subnet in range(chain.spec.preset.max_committees_per_slot):
+            self.gossip.subscribe(Topic.attestation_subnet(subnet))
+
+        self.rpc.register("status", self._handle_status)
+        self.rpc.register("ping", lambda peer, p: {"seq": 1})
+        self.rpc.register("metadata",
+                          lambda peer, p: {"seq_number": 1, "attnets": "ff"})
+        self.rpc.register("goodbye", self._handle_goodbye)
+        self.rpc.register("beacon_blocks_by_range", self._blocks_by_range)
+        self.rpc.register("beacon_blocks_by_root", self._blocks_by_root)
+
+    @property
+    def port(self) -> int:
+        return self.transport.port
+
+    def start(self) -> None:
+        self.transport.start()
+        for (host, port) in (self.config.boot_nodes or []):
+            self.dial(host, port)
+
+    def stop(self) -> None:
+        self.transport.stop()
+
+    def dial(self, host: str, port: int):
+        peer = self.transport.dial(host, port)
+        return peer
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _on_peer(self, peer) -> None:
+        self.peers.on_connect(peer.node_id)
+        threading.Thread(target=self._status_exchange, args=(peer,),
+                         daemon=True).start()
+
+    def _on_frame(self, peer, kind: int, payload: bytes) -> None:
+        if kind == GossipEngine.GOSSIP_FRAME:
+            self.gossip.handle_frame(peer, payload)
+        else:
+            self.rpc.handle_frame(peer, kind, payload)
+
+    def _ban(self, node_id: str) -> None:
+        peer = self.transport.peers.get(node_id)
+        if peer is not None:
+            peer.close()
+
+    def local_status(self) -> StatusMessage:
+        chain = self.chain
+        head = chain.head()
+        fin_epoch, fin_root = chain.finalized_checkpoint()
+        return StatusMessage(
+            fork_digest=self.gossip.fork_digest,
+            finalized_root=fin_root, finalized_epoch=fin_epoch,
+            head_root=head.head_block_root,
+            head_slot=head.head_state.slot)
+
+    def _status_exchange(self, peer) -> None:
+        try:
+            resp = self.rpc.request(peer, "status",
+                                    self.local_status().to_json())
+            status = StatusMessage.from_json(resp)
+        except (TimeoutError, RuntimeError, KeyError, ValueError):
+            return
+        if status.fork_digest != self.gossip.fork_digest:
+            try:
+                self.rpc.request(peer, "goodbye",
+                                 {"reason": "irrelevant_network"},
+                                 timeout=2.0)
+            except (TimeoutError, RuntimeError):
+                pass
+            finally:
+                peer.close()
+            return
+        self.peers.set_status(peer.node_id, status)
+        self.sync.maybe_sync()
+
+    def _handle_status(self, peer, payload) -> dict:
+        try:
+            status = StatusMessage.from_json(payload)
+            self.peers.set_status(peer.node_id, status)
+        except (KeyError, ValueError):
+            pass
+        return self.local_status().to_json()
+
+    def _handle_goodbye(self, peer, payload) -> dict:
+        # respond first, close shortly after, so the requester sees the ack
+        threading.Timer(0.2, peer.close).start()
+        return {}
+
+    def _blocks_by_range(self, peer, payload) -> list[str]:
+        start = int(payload["start_slot"])
+        count = min(int(payload["count"]),
+                    self.chain.spec.max_request_blocks)
+        out = []
+        seen = None
+        for slot in range(start, start + count):
+            root = self.chain.block_root_at_slot(slot)
+            if root is None or root == seen:
+                continue
+            seen = root
+            blk = self.chain.store.get_block(root)
+            if blk is not None and blk.message.slot >= start:
+                out.append(encode_block(blk))
+        return out
+
+    def _blocks_by_root(self, peer, payload) -> list[str]:
+        out = []
+        for root_hex in payload.get("roots", [])[:64]:
+            blk = self.chain.store.get_block(bytes.fromhex(root_hex))
+            if blk is not None:
+                out.append(encode_block(blk))
+        return out
+
+    # -- gossip validation / delivery ----------------------------------------
+
+    def _validate_gossip(self, topic: str, data: bytes):
+        """Returns (result, ctx): ctx carries the verified object to
+        delivery on this thread (no shared mutable hand-off)."""
+        chain = self.chain
+        try:
+            if topic == Topic.BLOCK:
+                fork = chain.spec.fork_name_at_slot(max(chain.slot(), 0))
+                signed = deserialize(
+                    chain.T.SignedBeaconBlock[fork].ssz_type, data)
+                chain.verify_block_for_gossip(signed)
+                return "accept", signed
+            if topic.startswith("beacon_attestation_"):
+                att = deserialize(chain.T.Attestation.ssz_type, data)
+                v = chain.verify_unaggregated_attestation_for_gossip(att)
+                return "accept", v
+            if topic == Topic.AGGREGATE:
+                agg = deserialize(
+                    chain.T.SignedAggregateAndProof.ssz_type, data)
+                v = chain.verify_aggregated_attestation_for_gossip(agg)
+                return "accept", v
+            return "accept", None
+        except BlockError as e:
+            if e.kind in ("parent_unknown",):
+                return "ignore", None
+            return ("reject" if e.kind in ("repeat_proposal",
+                                           "invalid_signature",
+                                           "incorrect_proposer")
+                    else "ignore"), None
+        except AttestationError as e:
+            return ("ignore" if e.kind in ("prior_attestation_known",
+                                           "unknown_head_block",
+                                           "future_slot") else "reject"), \
+                None
+        except Exception:
+            return "reject", None
+
+    def _deliver_gossip(self, topic: str, data: bytes, peer, ctx) -> None:
+        """Route accepted gossip into the priority processor when present
+        (network_beacon_processor role), else import inline."""
+        if ctx is None:
+            return
+        if self.processor is not None:
+            from ..beacon_processor import Work, WorkType
+            if topic == Topic.BLOCK:
+                self.processor.submit(Work(
+                    WorkType.GOSSIP_BLOCK,
+                    lambda: self._import_gossip_block(ctx, peer)))
+            elif topic.startswith("beacon_attestation_"):
+                self.processor.submit(Work(
+                    WorkType.GOSSIP_ATTESTATION, lambda: None,
+                    batchable_payload=ctx))
+            elif topic == Topic.AGGREGATE:
+                self.processor.submit(Work(
+                    WorkType.GOSSIP_AGGREGATE,
+                    lambda: self._apply_verified(ctx),
+                    batchable_payload=ctx))
+            return
+        try:
+            if topic == Topic.BLOCK:
+                self._import_gossip_block(ctx, peer)
+            elif topic.startswith("beacon_attestation_") or \
+                    topic == Topic.AGGREGATE:
+                self._apply_verified(ctx)
+        except Exception:
+            import logging
+            logging.getLogger("lighthouse_tpu.network").exception(
+                "gossip delivery failed")
+
+    def _import_gossip_block(self, signed, peer) -> None:
+        try:
+            self.chain.process_block(signed, proposal_already_verified=True)
+        except BlockError as e:
+            if e.kind == "parent_unknown":
+                self.sync.lookup_unknown_parent(htr(signed.message),
+                                                peer.node_id)
+
+    def _apply_verified(self, v) -> None:
+        self.chain.apply_attestation_to_fork_choice(v)
+        self.chain.add_to_op_pool(v)
+
+    def _attestation_batch(self, verified_list) -> None:
+        for v in verified_list:
+            if v is not None:
+                self._apply_verified(v)
+
+    # -- publishing ----------------------------------------------------------
+
+    def publish_block(self, signed_block) -> None:
+        data = serialize(type(signed_block).ssz_type, signed_block)
+        self.gossip.publish(Topic.BLOCK, data)
+
+    def publish_attestation(self, attestation, subnet: int = 0) -> None:
+        data = serialize(type(attestation).ssz_type, attestation)
+        self.gossip.publish(Topic.attestation_subnet(subnet), data)

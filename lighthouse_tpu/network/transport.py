@@ -1,0 +1,158 @@
+"""Framed TCP transport.
+
+Frames: [u32 len][u8 kind][payload]. kind: 0 = handshake, 1 = gossip,
+2 = rpc request, 3 = rpc response. Each peer connection runs a reader
+thread dispatching into the owning service's handlers.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import uuid
+
+
+class Peer:
+    def __init__(self, sock: socket.socket, addr, node_id: str,
+                 outbound: bool):
+        self.sock = sock
+        self.addr = addr
+        self.node_id = node_id
+        self.outbound = outbound
+        self._send_lock = threading.Lock()
+        self.alive = True
+
+    def send_frame(self, kind: int, payload: bytes) -> None:
+        frame = struct.pack("<IB", len(payload) + 1, kind) + payload
+        with self._send_lock:
+            try:
+                self.sock.sendall(frame)
+            except OSError:
+                self.alive = False
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Transport:
+    """Listener + dialer; hands connected Peers to `on_peer`, frames to
+    `on_frame(peer, kind, payload)`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 node_id: str | None = None):
+        self.node_id = node_id or uuid.uuid4().hex[:16]
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind((host, port))
+        self.listener.listen(64)
+        self.port = self.listener.getsockname()[1]
+        self.host = host
+        self.on_peer = lambda peer: None
+        self.on_frame = lambda peer, kind, payload: None
+        self.on_disconnect = lambda peer: None
+        self.peers: dict[str, Peer] = {}
+        self._stop = False
+
+    def start(self) -> None:
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        for p in list(self.peers.values()):
+            p.close()
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                sock, addr = self.listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handshake_in,
+                             args=(sock, addr), daemon=True).start()
+
+    def _handshake_in(self, sock, addr) -> None:
+        try:
+            kind, payload = _read_frame(sock)
+            if kind != 0:
+                sock.close()
+                return
+            hello = json.loads(payload)
+            sock.sendall(_frame(0, json.dumps(
+                {"node_id": self.node_id}).encode()))
+            peer = Peer(sock, addr, hello["node_id"], outbound=False)
+            self._register(peer)
+        except (OSError, ValueError, KeyError):
+            sock.close()
+
+    def dial(self, host: str, port: int) -> Peer | None:
+        try:
+            sock = socket.create_connection((host, port), timeout=5)
+            sock.sendall(_frame(0, json.dumps(
+                {"node_id": self.node_id}).encode()))
+            kind, payload = _read_frame(sock)
+            if kind != 0:
+                sock.close()
+                return None
+            hello = json.loads(payload)
+            peer = Peer(sock, (host, port), hello["node_id"], outbound=True)
+            self._register(peer)
+            return peer
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _register(self, peer: Peer) -> None:
+        self.peers[peer.node_id] = peer
+        threading.Thread(target=self._read_loop, args=(peer,),
+                         daemon=True).start()
+        self.on_peer(peer)
+
+    def _read_loop(self, peer: Peer) -> None:
+        import logging
+        try:
+            while peer.alive and not self._stop:
+                kind, payload = _read_frame(peer.sock)
+                try:
+                    self.on_frame(peer, kind, payload)
+                except Exception:
+                    # a handler bug must not kill the reader / skip cleanup
+                    logging.getLogger("lighthouse_tpu.network").exception(
+                        "frame handler failed (peer %s)", peer.node_id)
+        except (OSError, ValueError):
+            pass
+        peer.alive = False
+        # a redialed peer may have replaced this entry — only pop ourselves
+        if self.peers.get(peer.node_id) is peer:
+            self.peers.pop(peer.node_id, None)
+            self.on_disconnect(peer)
+
+
+def _frame(kind: int, payload: bytes) -> bytes:
+    return struct.pack("<IB", len(payload) + 1, kind) + payload
+
+
+def _read_frame(sock) -> tuple[int, bytes]:
+    hdr = _read_exact(sock, 5)
+    (length, kind) = struct.unpack("<IB", hdr)
+    if length > 64 * 1024 * 1024:
+        raise ValueError("frame too large")
+    payload = _read_exact(sock, length - 1)
+    return kind, payload
+
+
+def _read_exact(sock, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise OSError("connection closed")
+        out += chunk
+    return out

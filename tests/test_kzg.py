@@ -1,0 +1,51 @@
+"""KZG commitments on our own pairing (devnet setup)."""
+import pytest
+
+from lighthouse_tpu.crypto.kzg import Kzg, KzgError
+from lighthouse_tpu.crypto.bls12_381.fields import R
+
+
+@pytest.fixture(scope="module")
+def kzg():
+    return Kzg(devnet_size=8)
+
+
+def _blob(values, size=8):
+    assert len(values) <= size
+    vals = list(values) + [0] * (size - len(values))
+    return b"".join(v.to_bytes(32, "big") for v in vals)
+
+
+def test_commit_and_verify_proof(kzg):
+    blob = _blob([5, 7, 11, 13])
+    c = kzg.blob_to_kzg_commitment(blob)
+    proof, y = kzg.compute_kzg_proof(blob, z=12345)
+    assert kzg.verify_kzg_proof(c, 12345, y, proof)
+    assert not kzg.verify_kzg_proof(c, 12345, (y + 1) % R, proof)
+    assert not kzg.verify_kzg_proof(c, 12346, y, proof)
+
+
+def test_blob_proof_roundtrip(kzg):
+    blob = _blob([1, 2, 3, 4, 5])
+    c = kzg.blob_to_kzg_commitment(blob)
+    proof = kzg.compute_blob_kzg_proof(blob, c)
+    assert kzg.verify_blob_kzg_proof(blob, c, proof)
+    other = _blob([9, 9, 9])
+    assert not kzg.verify_blob_kzg_proof(other, c, proof)
+    assert kzg.verify_blob_kzg_proof_batch([blob], [c], [proof])
+
+
+def test_commitment_matches_evaluations(kzg):
+    """p evaluated on the domain must reproduce the blob values."""
+    vals = [3, 1, 4, 1, 5, 9, 2, 6]
+    blob = _blob(vals)
+    coeffs = kzg._coeffs(kzg._evals_from_blob(blob))
+    from lighthouse_tpu.crypto.kzg import _poly_eval
+    for x, want in zip(kzg.domain, vals):
+        assert _poly_eval(coeffs, x) == want
+
+
+def test_non_canonical_blob_rejected(kzg):
+    blob = (R).to_bytes(32, "big") * 8
+    with pytest.raises(KzgError):
+        kzg.blob_to_kzg_commitment(blob)

@@ -1,0 +1,109 @@
+"""Two-node in-process network tests over real TCP loopback.
+
+Equivalent of the reference's multi-node simulation approach (SURVEY.md §4:
+testing/simulator LocalNetwork — production objects, real sockets, one
+process).
+"""
+import time
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.network import NetworkConfig, NetworkService
+from lighthouse_tpu.specs import minimal_spec
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def _wait(cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_range_sync_and_gossip():
+    spec = minimal_spec()
+    ha = BeaconChainHarness(spec, 64)
+    hb = BeaconChainHarness(spec, 64)
+    ha.extend_chain(2 * spec.preset.slots_per_epoch)
+    hb.set_slot(ha.chain.slot())
+
+    na = NetworkService(ha.chain)
+    nb = NetworkService(hb.chain)
+    na.start()
+    nb.start()
+    try:
+        nb.dial("127.0.0.1", na.port)
+        # status exchange triggers range sync on B
+        assert _wait(lambda: hb.chain.head().head_block_root ==
+                     ha.chain.head().head_block_root), \
+            (hb.chain.head().head_state.slot,
+             ha.chain.head().head_state.slot)
+
+        # gossip: A produces one more block and floods it
+        ha.advance_slot()
+        hb.set_slot(ha.chain.slot())
+        signed, _post = ha.produce_signed_block()
+        ha.chain.process_block(signed)
+        na.publish_block(signed)
+        assert _wait(lambda: hb.chain.head().head_block_root ==
+                     ha.chain.head().head_block_root)
+        # peer scores stayed healthy
+        assert all(not p.banned for p in na.peers.connected())
+    finally:
+        na.stop()
+        nb.stop()
+
+
+def test_garbage_gossip_downscores_and_bans():
+    spec = minimal_spec()
+    ha = BeaconChainHarness(spec, 64)
+    hb = BeaconChainHarness(spec, 64)
+    na = NetworkService(ha.chain)
+    nb = NetworkService(hb.chain)
+    na.start()
+    nb.start()
+    try:
+        peer = nb.dial("127.0.0.1", na.port)
+        assert _wait(lambda: na.peers.connected())
+        # B floods garbage block gossip; A must reject and eventually ban
+        from lighthouse_tpu.network.gossip import Topic
+        for i in range(8):
+            nb.gossip.publish(Topic.BLOCK, b"garbage" + bytes([i]))
+        assert _wait(lambda: any(
+            p.banned for p in na.peers.peers.values()) or
+            not na.peers.connected(), timeout=10)
+    finally:
+        na.stop()
+        nb.stop()
+
+
+def test_rpc_blocks_by_root():
+    spec = minimal_spec()
+    ha = BeaconChainHarness(spec, 64)
+    hb = BeaconChainHarness(spec, 64)
+    roots = ha.extend_chain(4)
+    na = NetworkService(ha.chain)
+    nb = NetworkService(hb.chain)
+    na.start()
+    nb.start()
+    try:
+        peer = nb.dial("127.0.0.1", na.port)
+        resp = nb.rpc.request(peer, "beacon_blocks_by_root",
+                              {"roots": [roots[1].hex()]})
+        assert len(resp) == 1
+        from lighthouse_tpu.network.sync import SyncManager
+        blk = nb.sync._decode_block(resp[0])
+        from lighthouse_tpu.ssz import htr
+        assert htr(blk.message) == roots[1]
+    finally:
+        na.stop()
+        nb.stop()

@@ -1,0 +1,116 @@
+"""Service-layer tests: beacon processor, engine API, keystores, CLI."""
+import json
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.beacon_processor import BeaconProcessor, Work, WorkType
+from lighthouse_tpu.beacon_processor.reprocess import ReprocessQueue
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.key_derivation import derive_path
+from lighthouse_tpu.crypto.keystore import create_keystore, decrypt_keystore
+from lighthouse_tpu.execution_layer import (
+    EngineApiClient, EngineState, Engines, ExecutionLayer, JwtAuth,
+    MockEngineServer,
+)
+
+
+def test_processor_priority_and_batching():
+    done = []
+    batches = []
+    bp = BeaconProcessor(num_workers=2,
+                         batch_handler=lambda items: batches.append(items))
+    # fill attestation queue BEFORE starting so they batch
+    for i in range(10):
+        bp.submit(Work(WorkType.GOSSIP_ATTESTATION, lambda: None,
+                       batchable_payload=i))
+    bp.submit(Work(WorkType.GOSSIP_BLOCK, lambda: done.append("block")))
+    bp.start()
+    assert bp.wait_idle(10)
+    bp.stop()
+    assert done == ["block"]
+    assert sum(len(b) for b in batches) == 10
+    assert len(batches) <= 2  # opportunistic batching happened
+
+
+def test_reprocess_queue():
+    replayed = []
+    rq = ReprocessQueue(lambda w: replayed.append(w))
+    rq.park_until_slot(5, "a")
+    rq.park_until_slot(7, "b")
+    rq.park_until_block(b"\x01" * 32, "c")
+    assert rq.on_slot(5) == 1
+    assert rq.on_slot(6) == 0
+    assert rq.on_block_imported(b"\x01" * 32) == 1
+    assert replayed == ["a", "c"]
+
+
+def test_jwt_roundtrip():
+    auth = JwtAuth(b"\x11" * 32)
+    tok = auth.generate_token()
+    assert auth.validate(tok)
+    assert not auth.validate(tok[:-2] + "zz")
+    assert not JwtAuth(b"\x22" * 32).validate(tok)
+
+
+def test_engine_api_against_mock_server():
+    secret = b"\x42" * 32
+    srv = MockEngineServer(secret)
+    srv.start()
+    try:
+        client = EngineApiClient("127.0.0.1", srv.port, JwtAuth(secret))
+        caps = client.exchange_capabilities()
+        assert "engine_newPayloadV3" in caps
+        engines = Engines(client)
+        assert engines.upcheck() == EngineState.ONLINE
+        # forkchoice + invalidation scripting
+        el = ExecutionLayer(client)
+        status, _pid = el.notify_forkchoice_updated(b"\xaa" * 32,
+                                                    b"\x00" * 32,
+                                                    b"\x00" * 32)
+        assert status == "valid"
+        srv.invalid_hashes.add("0x" + "bb" * 32)
+        status, _ = el.notify_forkchoice_updated(b"\xbb" * 32, b"\x00" * 32,
+                                                 b"\x00" * 32)
+        assert status == "invalid"
+        srv.static_response = "SYNCING"
+        status, _ = el.notify_forkchoice_updated(b"\xaa" * 32, b"\x00" * 32,
+                                                 b"\x00" * 32)
+        assert status == "optimistic"
+        # wrong JWT is rejected
+        bad = EngineApiClient("127.0.0.1", srv.port, JwtAuth(b"\x43" * 32))
+        from lighthouse_tpu.execution_layer import EngineError
+        with pytest.raises(EngineError):
+            bad.exchange_capabilities()
+    finally:
+        srv.stop()
+
+
+def test_keystore_roundtrip():
+    bls.set_backend("fake")
+    sk = 123456789
+    ks = create_keystore(sk, b"hunter2")
+    assert ks["version"] == 4
+    assert decrypt_keystore(ks, b"hunter2") == sk
+    with pytest.raises(ValueError):
+        decrypt_keystore(ks, b"wrong")
+
+
+def test_eip2333_determinism():
+    seed = bytes(range(32))
+    sk1 = derive_path(seed, "m/12381/3600/0/0/0")
+    sk2 = derive_path(seed, "m/12381/3600/0/0/0")
+    sk3 = derive_path(seed, "m/12381/3600/1/0/0")
+    assert sk1 == sk2 != sk3
+    assert 0 < sk1 < 2**255
+
+
+def test_cli_dump_config(capsys):
+    from lighthouse_tpu.__main__ import main
+    rc = main(["--network", "minimal", "beacon_node", "--dump-config",
+               "--interop-validators", "8", "--slasher"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["slasher_enabled"] is True
+    assert out["interop_validator_count"] == 8
