@@ -117,9 +117,41 @@ def _run_beacon_node(spec, args):
 
 
 def _run_validator_client(spec, args):
-    print("validator_client: HTTP-client mode lands with the eth2 HTTP "
-          "client (round 2); in-process VC is available via "
-          "lighthouse_tpu.validator_client", file=sys.stderr)
+    import time as _time
+    from .client import Environment
+    from .crypto import bls
+    from .validator_client import (
+        BeaconNodeFallback, BeaconNodeHttpClient, SlashingDatabase,
+        ValidatorClient, ValidatorStore,
+    )
+    env = Environment(args.log_level)
+    clients = [BeaconNodeHttpClient(u.strip(), spec)
+               for u in args.beacon_nodes.split(",") if u.strip()]
+    nodes = BeaconNodeFallback(clients)
+    genesis = clients[0]._req("GET", "/eth/v1/beacon/genesis")["data"]
+    gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+    genesis_time = int(genesis["genesis_time"])
+    store = ValidatorStore(spec, gvr, SlashingDatabase(args.slashing_db))
+    for i in range(args.interop_validators):
+        store.add_validator(bls.keygen_interop(i))
+    vc = ValidatorClient(spec, store, nodes)
+    env.log.info("validator client: %d keys, %d beacon nodes",
+                 args.interop_validators, len(clients))
+
+    def loop():
+        last = -1
+        while not env.shutdown_requested():
+            slot = max(0, int(_time.time() - genesis_time)
+                       // spec.seconds_per_slot)
+            if slot != last and _time.time() >= genesis_time:
+                last = slot
+                try:
+                    vc.on_slot(slot)
+                except Exception:
+                    env.log.exception("slot duties failed")
+            _time.sleep(0.25)
+    env.spawn(loop, "vc-loop")
+    env.block_until_shutdown()
     return 0
 
 

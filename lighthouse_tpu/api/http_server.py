@@ -13,8 +13,23 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from ..ssz import deserialize
+from ..ssz import deserialize, serialize
 from .backend import ApiBackend, ApiError
+
+
+def _att_data_json(backend: ApiBackend, q) -> dict:
+    data = backend.attestation_data(int(q["slot"][0]),
+                                    int(q["committee_index"][0]))
+    t = type(data).ssz_type
+    return {"ssz": serialize(t, data).hex()}
+
+
+def _aggregate_ssz(backend: ApiBackend, q):
+    agg = backend.get_aggregate(int(q["slot"][0]),
+                                int(q["committee_index"][0]))
+    if agg is None:
+        raise ApiError(404, "no aggregate available")
+    return {"ssz": serialize(type(agg).ssz_type, agg).hex()}
 
 
 class BeaconApiServer:
@@ -64,6 +79,19 @@ def _make_handler(backend: ApiBackend):
          lambda m, q: {"data": {"healthy": backend.is_healthy()}}),
         (re.compile(r"^/lighthouse/syncing$"),
          lambda m, q: {"data": backend.syncing()}),
+        (re.compile(r"^/eth/v1/validator/attestation_data$"),
+         lambda m, q: {"data": _att_data_json(backend, q)}),
+        (re.compile(r"^/eth/v1/validator/validator_index$"),
+         lambda m, q: {"data": {"index": backend.get_validator_index(
+             bytes.fromhex(q["pubkey"][0][2:]))}}),
+        (re.compile(r"^/eth/v1/validator/fork_version$"),
+         lambda m, q: {"data": {
+             "version": "0x" + backend.head_fork_version().hex()}}),
+        (re.compile(r"^/eth/v1/validator/liveness/(\d+)$"),
+         lambda m, q: {"data": backend.seen_liveness(
+             [int(i) for i in q.get("id", [])], int(m[1]))}),
+        (re.compile(r"^/eth/v1/validator/aggregate_attestation$"),
+         lambda m, q: {"data": _aggregate_ssz(backend, q)}),
     ]
 
     class Handler(BaseHTTPRequestHandler):
@@ -102,6 +130,21 @@ def _make_handler(backend: ApiBackend):
                 except Exception:
                     backend.chain.events.unsubscribe(sub)
                 return
+            if url.path.startswith("/eth/v2/validator/blocks/"):
+                slot = int(url.path.rsplit("/", 1)[1])
+                reveal = bytes.fromhex(q["randao_reveal"][0][2:])
+                try:
+                    block = backend.produce_block(slot, reveal)
+                except ApiError as e:
+                    return self._json(e.status, {"message": str(e)})
+                raw = serialize(type(block).ssz_type, block)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Eth-Consensus-Version", "phase0")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+                return
             if url.path.startswith("/eth/v2/beacon/blocks/"):
                 block_id = url.path.rsplit("/", 1)[1]
                 try:
@@ -130,12 +173,32 @@ def _make_handler(backend: ApiBackend):
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
             try:
+                chain = backend.chain
                 if url.path == "/eth/v1/beacon/blocks":
-                    chain = backend.chain
                     fork = chain.spec.fork_name_at_slot(chain.slot())
                     cls = chain.T.SignedBeaconBlock[fork]
                     signed = deserialize(cls.ssz_type, body)
                     backend.publish_block(signed)
+                    return self._json(200, {})
+                m = re.match(r"^/eth/v1/validator/duties/attester/(\d+)$",
+                             url.path)
+                if m:
+                    indices = [int(i) for i in json.loads(body)]
+                    duties = backend.get_attester_duties(int(m[1]), indices)
+                    return self._json(200, {"data": [
+                        {"slot": str(s), "committee_index": str(ci),
+                         "validator_index": str(vi),
+                         "committee_length": str(cl),
+                         "validator_committee_index": str(pos)}
+                        for s, ci, vi, cl, pos in duties]})
+                if url.path == "/eth/v1/beacon/pool/attestations":
+                    att = deserialize(chain.T.Attestation.ssz_type, body)
+                    backend.publish_attestation(att)
+                    return self._json(200, {})
+                if url.path == "/eth/v1/validator/aggregate_and_proofs":
+                    agg = deserialize(
+                        chain.T.SignedAggregateAndProof.ssz_type, body)
+                    backend.publish_aggregate(agg)
                     return self._json(200, {})
                 return self._json(404, {"message": "route not found"})
             except ApiError as e:

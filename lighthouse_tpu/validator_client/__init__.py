@@ -9,3 +9,4 @@ from .slashing_protection import SlashingDatabase, SlashingError
 from .validator_store import ValidatorStore
 from .client import ValidatorClient, BeaconNodeInterface
 from .fallback import BeaconNodeFallback
+from .http_client import BeaconNodeHttpClient

@@ -84,6 +84,34 @@ def test_vc_drives_chain_to_finalization():
         chain.justified_checkpoint(), chain.finalized_checkpoint())
 
 
+def test_vc_over_http():
+    """The full VC loop against the real HTTP server (common/eth2 client)."""
+    from lighthouse_tpu.api import BeaconApiServer
+    from lighthouse_tpu.validator_client import BeaconNodeHttpClient
+    spec = minimal_spec()
+    h = BeaconChainHarness(spec, 64)
+    srv = BeaconApiServer(ApiBackend(h.chain))
+    srv.start()
+    try:
+        client = BeaconNodeHttpClient(f"http://127.0.0.1:{srv.port}", spec)
+        assert client.is_healthy()
+        store = ValidatorStore(spec, h.chain.genesis_validators_root)
+        for sk in h.secret_keys:
+            store.add_validator(sk)
+        vc = ValidatorClient(spec, store, BeaconNodeFallback([client]))
+        for _ in range(3 * spec.preset.slots_per_epoch):
+            h.advance_slot()
+            vc.on_slot(h.chain.slot())
+            h.chain.recompute_head()
+        assert vc.published_blocks >= 3 * spec.preset.slots_per_epoch - 2
+        assert vc.published_attestations > 0
+        assert h.chain.head().head_state.slot >= \
+            3 * spec.preset.slots_per_epoch - 1
+        assert h.chain.justified_checkpoint()[0] >= 1
+    finally:
+        srv.stop()
+
+
 def test_store_refuses_double_proposal():
     spec = minimal_spec()
     h = BeaconChainHarness(spec, 64)
