@@ -80,6 +80,11 @@ class OperationPool:
             candidates = [a for bucket in self._attestations.values()
                           for a in bucket]
         for att in candidates:
+            # fork-shape filter: electra bodies take committee_bits
+            # attestations only (and vice versa) — pre-fork pool entries
+            # are unpackable across the boundary
+            if electra != hasattr(att, "committee_bits"):
+                continue
             d = att.data
             if d.target.epoch not in (prev, cur):
                 continue

@@ -32,10 +32,16 @@ def pack_bytes(data: bytes) -> list[bytes]:
     return [data[i:i + 32] for i in range(0, len(data), 32)]
 
 
+#: chunk count above which the C++ batch hasher takes over from hashlib
+_NATIVE_THRESHOLD = 32
+
+
 def merkleize_chunks(chunks: list[bytes], limit: int | None = None) -> bytes:
     """Merkleize chunks into a single root, padding with zero subtrees.
 
     ``limit`` is the maximum chunk count (defines tree depth for Lists).
+    Large trees route through the C++ batch hasher (one FFI call per tree);
+    small ones stay on hashlib.
     """
     count = len(chunks)
     if limit is None:
@@ -45,6 +51,15 @@ def merkleize_chunks(chunks: list[bytes], limit: int | None = None) -> bytes:
     depth = max(0, (limit - 1).bit_length())
     if count == 0:
         return ZERO_HASHES[depth]
+    if count >= _NATIVE_THRESHOLD:
+        from ..utils.native_hash import get_lib, merkle_root_pow2
+        if get_lib() is not None:
+            dense = next_pow_of_two(count)
+            data = b"".join(chunks) + b"\x00" * 32 * (dense - count)
+            root = merkle_root_pow2(data)
+            for d in range(dense.bit_length() - 1, depth):
+                root = hash_concat(root, ZERO_HASHES[d])
+            return root
     nodes = list(chunks)
     for d in range(depth):
         if len(nodes) % 2:

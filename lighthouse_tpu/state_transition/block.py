@@ -557,7 +557,10 @@ def process_consolidation_request(state: BeaconState, request) -> None:
         idx = state.validators.index_of(request.source_pubkey)
         _switch_to_compounding_validator(state, idx)
         return
-    # churn sanity
+    # spec: no capacity when the consolidation churn can't fit one validator
+    from .helpers import get_consolidation_churn_limit
+    if get_consolidation_churn_limit(state) <= p.min_activation_balance:
+        return
     if len(state.pending_consolidations) >= p.pending_consolidations_limit:
         return
     src = state.validators.index_of(request.source_pubkey)

@@ -1,0 +1,65 @@
+"""ctypes binding for the C++ batch SHA-256 (native/sha256_host.cpp).
+
+The host-side analog of `ethereum_hashing`: one FFI crossing per merkle
+level. Falls back cleanly when the library is missing (pure hashlib paths
+keep working).
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_lib = None
+_checked = False
+
+
+def get_lib():
+    global _lib, _checked
+    if _checked:
+        return _lib
+    _checked = True
+    root = Path(__file__).resolve().parents[2]
+    so = root / "native" / "libsha256host.so"
+    try:
+        if not so.exists():
+            subprocess.run(["sh", str(root / "native" / "build.sh")],
+                           check=True, capture_output=True)
+        lib = ctypes.CDLL(str(so))
+        lib.sha256_have_shani.restype = ctypes.c_int
+        lib.sha256_hash64_batch.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                            ctypes.c_uint64]
+        lib.sha256_merkle_root.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                           ctypes.c_char_p, ctypes.c_char_p]
+        lib.sha256_oneshot.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_char_p]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def have_shani() -> bool:
+    lib = get_lib()
+    return bool(lib and lib.sha256_have_shani())
+
+
+def hash64_batch(data: bytes) -> bytes:
+    """n*64 bytes in -> n*32 digests out."""
+    lib = get_lib()
+    n = len(data) // 64
+    out = ctypes.create_string_buffer(n * 32)
+    lib.sha256_hash64_batch(data, out, n)
+    return out.raw
+
+
+def merkle_root_pow2(leaves: bytes) -> bytes:
+    """Dense merkle root of a power-of-two number of 32-byte leaves."""
+    lib = get_lib()
+    n = len(leaves) // 32
+    root = ctypes.create_string_buffer(32)
+    scratch = ctypes.create_string_buffer(max(32, (n // 2) * 32))
+    lib.sha256_merkle_root(leaves, n, root, scratch)
+    return root.raw

@@ -1,0 +1,216 @@
+// Batch SHA-256 host hasher (C++ runtime).
+//
+// Role: the reference links `ethereum_hashing` (sha2-asm / SHA-NI) for host
+// merkleization (SURVEY.md §2.6). This library provides the same: a portable
+// unrolled SHA-256 with a runtime-dispatched x86 SHA-NI fast path, exposed as
+// BATCH calls over a C ABI (ctypes) so Python pays one FFI crossing per
+// merkle level, not per hash.
+//
+// Build: native/build.sh (g++ -O3 -march=native).
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__)
+#include <cpuid.h>
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+constexpr uint32_t IV[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+inline uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+inline void put_be32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+
+void compress_portable(uint32_t state[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++) w[i] = be32(block + 4 * i);
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1; d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+#if defined(__x86_64__)
+bool have_shani() {
+  unsigned a, b, c, d;
+  if (!__get_cpuid_count(7, 0, &a, &b, &c, &d)) return false;
+  return (b >> 29) & 1;  // EBX bit 29: SHA
+}
+
+__attribute__((target("sha,sse4.1")))
+void compress_shani(uint32_t state[8], const uint8_t block[64]) {
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  __m128i STATE0 = _mm_loadu_si128((const __m128i*)&state[0]);
+  __m128i STATE1 = _mm_loadu_si128((const __m128i*)&state[4]);
+  __m128i TMP = _mm_shuffle_epi32(STATE0, 0xB1);       // CDAB
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);            // EFGH
+  STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);            // ABEF
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);         // CDGH
+  const __m128i ABEF_SAVE = STATE0;
+  const __m128i CDGH_SAVE = STATE1;
+
+  __m128i MSG, MSG0, MSG1, MSG2, MSG3;
+#define QROUND(Ki, M)                                        \
+  MSG = _mm_add_epi32(M, _mm_loadu_si128((const __m128i*)&K[Ki])); \
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);       \
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);                        \
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+  MSG0 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 0)), MASK);
+  MSG1 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 16)), MASK);
+  MSG2 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 32)), MASK);
+  MSG3 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 48)), MASK);
+
+  QROUND(0, MSG0);
+  QROUND(4, MSG1);
+  QROUND(8, MSG2);
+  QROUND(12, MSG3);
+  for (int i = 16; i < 64; i += 16) {
+    MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+    MSG0 = _mm_add_epi32(MSG0, _mm_alignr_epi8(MSG3, MSG2, 4));
+    MSG0 = _mm_sha256msg2_epu32(MSG0, MSG3);
+    QROUND(i + 0, MSG0);
+    MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+    MSG1 = _mm_add_epi32(MSG1, _mm_alignr_epi8(MSG0, MSG3, 4));
+    MSG1 = _mm_sha256msg2_epu32(MSG1, MSG0);
+    QROUND(i + 4, MSG1);
+    MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+    MSG2 = _mm_add_epi32(MSG2, _mm_alignr_epi8(MSG1, MSG0, 4));
+    MSG2 = _mm_sha256msg2_epu32(MSG2, MSG1);
+    QROUND(i + 8, MSG2);
+    MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+    MSG3 = _mm_add_epi32(MSG3, _mm_alignr_epi8(MSG2, MSG1, 4));
+    MSG3 = _mm_sha256msg2_epu32(MSG3, MSG2);
+    QROUND(i + 12, MSG3);
+  }
+#undef QROUND
+  STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+  STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+  TMP = _mm_shuffle_epi32(STATE0, 0x1B);               // FEBA
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);            // DCHG
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);         // DCBA
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);            // HGFE
+  _mm_storeu_si128((__m128i*)&state[0], STATE0);
+  _mm_storeu_si128((__m128i*)&state[4], STATE1);
+}
+#endif
+
+using CompressFn = void (*)(uint32_t[8], const uint8_t[64]);
+
+CompressFn pick_compress() {
+#if defined(__x86_64__)
+  if (have_shani()) return compress_shani;
+#endif
+  return compress_portable;
+}
+
+CompressFn g_compress = pick_compress();
+
+// digest of a 64-byte message (merkle combiner): data block + const padding
+void hash64(const uint8_t in[64], uint8_t out[32]) {
+  uint32_t st[8];
+  memcpy(st, IV, sizeof(st));
+  g_compress(st, in);
+  uint8_t pad[64] = {0};
+  pad[0] = 0x80;
+  pad[62] = 0x02;  // bit length 512 big-endian = 0x0200
+  g_compress(st, pad);
+  for (int i = 0; i < 8; i++) put_be32(out + 4 * i, st[i]);
+}
+
+}  // namespace
+
+extern "C" {
+
+int sha256_have_shani() {
+#if defined(__x86_64__)
+  return have_shani() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// n independent 64-byte inputs -> n 32-byte digests
+void sha256_hash64_batch(const uint8_t* in, uint8_t* out, uint64_t n) {
+  for (uint64_t i = 0; i < n; i++) hash64(in + 64 * i, out + 32 * i);
+}
+
+// one merkle level: 2n child nodes (32B each, concatenated) -> n parents
+void sha256_merkle_level(const uint8_t* children, uint8_t* parents,
+                         uint64_t n_parents) {
+  sha256_hash64_batch(children, parents, n_parents);
+}
+
+// full dense merkle tree root over n_leaves (power of two) 32-byte leaves
+void sha256_merkle_root(const uint8_t* leaves, uint64_t n_leaves,
+                        uint8_t* root_out, uint8_t* scratch) {
+  // scratch must hold n_leaves/2 * 32 bytes
+  if (n_leaves == 1) {
+    memcpy(root_out, leaves, 32);
+    return;
+  }
+  uint64_t n = n_leaves / 2;
+  sha256_hash64_batch(leaves, scratch, n);
+  while (n > 1) {
+    sha256_hash64_batch(scratch, scratch, n / 2);
+    n /= 2;
+  }
+  memcpy(root_out, scratch, 32);
+}
+
+// general sha256
+void sha256_oneshot(const uint8_t* data, uint64_t len, uint8_t* out) {
+  uint32_t st[8];
+  memcpy(st, IV, sizeof(st));
+  uint64_t full = len / 64;
+  for (uint64_t i = 0; i < full; i++) g_compress(st, data + 64 * i);
+  uint8_t tail[128] = {0};
+  uint64_t rem = len - 64 * full;
+  memcpy(tail, data + 64 * full, rem);
+  tail[rem] = 0x80;
+  uint64_t bits = len * 8;
+  int tail_blocks = (rem + 9 <= 64) ? 1 : 2;
+  uint8_t* lenp = tail + 64 * tail_blocks - 8;
+  for (int i = 7; i >= 0; i--) { lenp[i] = bits & 0xFF; bits >>= 8; }
+  for (int i = 0; i < tail_blocks; i++) g_compress(st, tail + 64 * i);
+  for (int i = 0; i < 8; i++) put_be32(out + 4 * i, st[i]);
+}
+
+}  // extern "C"

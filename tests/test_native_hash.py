@@ -1,0 +1,69 @@
+"""C++ batch hasher vs hashlib; merkleize_chunks native/pure equivalence."""
+import hashlib
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ssz import merkleize_chunks
+from lighthouse_tpu.ssz.merkle import _NATIVE_THRESHOLD
+from lighthouse_tpu.utils import native_hash
+from lighthouse_tpu.utils.hash import ZERO_HASHES, hash_concat
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native_hash.get_lib()
+    if lib is None:
+        pytest.skip("native sha256 library unavailable")
+    return lib
+
+
+def test_hash64_batch_matches_hashlib(lib):
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, 64 * 33, dtype=np.uint8).tobytes()
+    out = native_hash.hash64_batch(data)
+    for i in range(33):
+        assert out[i * 32:(i + 1) * 32] == \
+            hashlib.sha256(data[i * 64:(i + 1) * 64]).digest()
+
+
+def test_merkle_root_pow2(lib):
+    rng = np.random.default_rng(6)
+    leaves = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+              for _ in range(64)]
+    got = native_hash.merkle_root_pow2(b"".join(leaves))
+    nodes = leaves
+    while len(nodes) > 1:
+        nodes = [hash_concat(nodes[i], nodes[i + 1])
+                 for i in range(0, len(nodes), 2)]
+    assert got == nodes[0]
+
+
+def test_merkleize_chunks_native_pure_equivalence(lib):
+    rng = np.random.default_rng(7)
+    # sizes straddling the native threshold, odd counts, zero caps
+    for n, limit in [(_NATIVE_THRESHOLD, 64), (33, 64), (100, 256),
+                     (64, 1 << 12), (65, 128)]:
+        chunks = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+                  for _ in range(n)]
+        native = merkleize_chunks(chunks, limit)
+        # force the pure path by chunking below threshold
+        import lighthouse_tpu.ssz.merkle as m
+        saved = m._NATIVE_THRESHOLD
+        m._NATIVE_THRESHOLD = 10**9
+        try:
+            pure = merkleize_chunks(chunks, limit)
+        finally:
+            m._NATIVE_THRESHOLD = saved
+        assert native == pure, (n, limit)
+
+
+def test_oneshot(lib):
+    for n in (0, 1, 55, 56, 64, 100, 1000):
+        data = bytes(range(256)) * 4
+        data = data[:n]
+        out = bytes(32)
+        import ctypes
+        buf = ctypes.create_string_buffer(32)
+        lib.sha256_oneshot(data, n, buf)
+        assert buf.raw == hashlib.sha256(data).digest()
