@@ -164,7 +164,7 @@ def _run_account_manager(spec, args):
         sk = bls.keygen_interop(i)
         pk = bls.sk_to_pk(sk)
         ks = create_keystore(sk, args.password.encode())
-        path = os.path.join(args.out, f"keystore-{pk.hex()[:12]}.json")
+        path = os.path.join(args.out, f"keystore-{i}-{pk.hex()[:12]}.json")
         with open(path, "w") as f:
             json.dump(ks, f, indent=2)
         print(f"wrote {path}")

@@ -300,6 +300,30 @@ class ApiBackend:
             if e.kind not in ("prior_attestation_known",):
                 raise ApiError(400, f"aggregate rejected: {e}")
 
+    def get_sync_duties(self, epoch: int, indices: list[int]) -> list[int]:
+        """Validator indices (of the requested set) in the current sync
+        committee."""
+        st = self.chain.head().head_state
+        if st.current_sync_committee is None:
+            return []
+        members = set()
+        for pk in st.current_sync_committee.pubkeys:
+            i = st.validators.index_of(pk)
+            if i is not None:
+                members.add(i)
+        return [i for i in indices if i in members]
+
+    def publish_sync_committee_message(self, msg) -> None:
+        from ..chain.errors import AttestationError
+        try:
+            self.chain.sync_committee_pool.verify_and_add_message(msg)
+        except AttestationError as e:
+            if e.kind != "prior_attestation_known":
+                raise ApiError(400, f"sync message rejected: {e}")
+
+    def head_root(self) -> bytes:
+        return self.chain.head().head_block_root
+
     def head_fork_version(self) -> bytes:
         return self.chain.head().head_state.fork.current_version
 

@@ -101,8 +101,13 @@ class BeaconChain:
 
         self.op_pool = OperationPool(self.T)
         self.events = EventHandler()
+        from .light_client import LightClientServerCache
+        self.light_client_cache = LightClientServerCache(self)
+        from .sync_committee import SyncCommitteePool
+        self.sync_committee_pool = SyncCommitteePool(self)
         self.block_times: dict[bytes, dict] = {}
         self.validator_monitor = None  # wired by the client builder
+        self.eth1_service = None       # optional Eth1Service
 
         store.store_genesis(self.genesis_block_root, genesis_state)
         if genesis_block is not None:
@@ -235,6 +240,13 @@ class BeaconChain:
             self._cache_snapshot(block_root, state)
         self.events.emit("block", {"slot": block.slot,
                                    "block_root": block_root})
+        if self.config.enable_light_client_server:
+            try:
+                self.light_client_cache.on_head_update(ep.signed_block, state)
+            except Exception:
+                import logging
+                logging.getLogger("lighthouse_tpu.chain").exception(
+                    "light client cache update failed")
         self.recompute_head()
         return block_root
 
@@ -448,7 +460,8 @@ class BeaconChain:
 
     def produce_block(self, randao_reveal: bytes, slot: int,
                       graffiti: bytes = b"\x00" * 32,
-                      skip_randao_verification: bool = False):
+                      skip_randao_verification: bool = False,
+                      sync_aggregate=None):
         """3-phase production (beacon_chain.rs:4810): (1) state advance +
         op-pool packing, (2) payload retrieval, (3) completion + state root.
         Returns (block, post_state)."""
@@ -466,18 +479,32 @@ class BeaconChain:
         proposer_sl, attester_sl, exits, changes = \
             self.op_pool.get_slashings_and_exits(state)
 
+        # eth1 voting + mandatory deposits (eth1/src/service.rs)
+        eth1_data = state.eth1_data
+        deposits = []
+        if self.eth1_service is not None:
+            eth1_data = self.eth1_service.eth1_data_for_block(state)
+            from ..state_transition.block import process_eth1_data
+            scratch = state.copy()
+            process_eth1_data(scratch, eth1_data)
+            deposits = self.eth1_service.deposits_for_block(scratch)
+
         body_cls = T.BeaconBlockBody[fork]
         body = body_cls(
             randao_reveal=randao_reveal,
-            eth1_data=state.eth1_data, graffiti=graffiti,
+            eth1_data=eth1_data, graffiti=graffiti,
             proposer_slashings=proposer_sl,
             attester_slashings=attester_sl,
-            attestations=attestations, deposits=[],
+            attestations=attestations, deposits=deposits,
             voluntary_exits=exits)
         if fork >= ForkName.CAPELLA:
             body.bls_to_execution_changes = changes
         if fork >= ForkName.ALTAIR:
-            body.sync_aggregate = self._empty_sync_aggregate()
+            if sync_aggregate is None:
+                # pull pooled sync messages signed over the parent at slot-1
+                sync_aggregate = self.sync_committee_pool.\
+                    produce_sync_aggregate(max(slot, 1) - 1, parent_root)
+            body.sync_aggregate = sync_aggregate
         if fork >= ForkName.BELLATRIX:
             body.execution_payload = self._produce_payload(state, fork)
 

@@ -8,7 +8,8 @@ substrate for chain/store/API tests (SURVEY.md §4).
 from __future__ import annotations
 
 from ..crypto import bls
-from ..specs.chain_spec import ChainSpec, ForkName, compute_signing_root
+from ..specs.chain_spec import ChainSpec, compute_signing_root
+from ..specs.chain_spec import ForkName
 from ..specs.constants import DOMAIN_BEACON_PROPOSER, DOMAIN_RANDAO
 from ..ssz import hash_tree_root, htr, uint64
 from ..state_transition.helpers import (
@@ -125,7 +126,12 @@ class BeaconChainHarness:
         from ..state_transition.helpers import get_beacon_proposer_index
         proposer = get_beacon_proposer_index(proposer_state, slot)
         reveal = self.randao_reveal(proposer_state, slot, proposer)
-        block, post = chain.produce_block(reveal, slot)
+        sync_agg = None
+        if proposer_state.fork_name >= ForkName.ALTAIR:
+            sync_agg = self.sh.produce_sync_aggregate(
+                proposer_state, slot, chain.head().head_block_root)
+        block, post = chain.produce_block(reveal, slot,
+                                          sync_aggregate=sync_agg)
         return self.sign_block(block, proposer_state), post
 
     def extend_chain(self, num_blocks: int, attest: bool = True) -> list:

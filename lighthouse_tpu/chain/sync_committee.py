@@ -1,0 +1,95 @@
+"""Sync-committee message verification + naive aggregation.
+
+Equivalent of the reference's sync-committee gossip pipelines
+(beacon_chain/src/sync_committee_verification.rs) and the naive aggregation
+pool feeding block production's SyncAggregate.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+from ..crypto import bls
+from ..specs.chain_spec import compute_signing_root
+from ..specs.constants import DOMAIN_SYNC_COMMITTEE
+from ..state_transition.helpers import get_domain
+from .errors import AttestationError, BAD_SIGNATURE, PRIOR_SEEN
+
+
+class SyncCommitteePool:
+    """(slot, beacon_block_root) -> participation bits + aggregated sig."""
+
+    def __init__(self, chain):
+        self.chain = chain
+        self._lock = threading.Lock()
+        # (slot, root) -> {committee position -> signature}
+        self._messages: dict[tuple, dict[int, bytes]] = defaultdict(dict)
+
+    def verify_and_add_message(self, msg) -> int:
+        """Gossip path: verify a SyncCommitteeMessage and pool it. Returns
+        the number of committee positions credited."""
+        chain = self.chain
+        state = chain.head().head_state
+        committee = state.current_sync_committee
+        vpk = state.validators.pubkey(msg.validator_index)
+        positions = [i for i, pk in enumerate(committee.pubkeys)
+                     if pk == vpk]
+        if not positions:
+            raise AttestationError("not_in_sync_committee",
+                                   str(msg.validator_index))
+        if chain.observed_sync_contributors.observe(msg.slot,
+                                                    msg.validator_index):
+            raise AttestationError(PRIOR_SEEN, "sync contributor")
+        domain = get_domain(state, DOMAIN_SYNC_COMMITTEE,
+                            msg.slot // state.slots_per_epoch)
+        signing_root = compute_signing_root(msg.beacon_block_root, domain)
+        if not bls.verify(vpk, signing_root, msg.signature):
+            raise AttestationError(BAD_SIGNATURE, "sync message")
+        with self._lock:
+            bucket = self._messages[(msg.slot, msg.beacon_block_root)]
+            for p in positions:
+                bucket[p] = msg.signature
+        return len(positions)
+
+    def produce_sync_aggregate(self, slot: int, beacon_block_root: bytes):
+        """Best SyncAggregate for a block at slot+1 (signed over `slot`)."""
+        T = self.chain.T
+        size = self.chain.spec.preset.sync_committee_size
+        with self._lock:
+            bucket = dict(self._messages.get((slot, beacon_block_root), {}))
+        bits = [i in bucket for i in range(size)]
+        sigs = [bucket[i] for i in sorted(bucket)]
+        agg = (bls.aggregate_signatures(sigs) if sigs
+               else bls.INFINITY_SIGNATURE)
+        return T.SyncAggregate(sync_committee_bits=bits,
+                               sync_committee_signature=agg)
+
+    def produce_contribution(self, slot: int, beacon_block_root: bytes,
+                             subcommittee_index: int):
+        """SyncCommitteeContribution for one subnet (VC aggregation duty)."""
+        T = self.chain.T
+        size = self.chain.spec.preset.sync_committee_size
+        sub_size = size // 4
+        start = subcommittee_index * sub_size
+        with self._lock:
+            bucket = dict(self._messages.get((slot, beacon_block_root), {}))
+        bits = []
+        sigs = []
+        for i in range(start, start + sub_size):
+            if i in bucket:
+                bits.append(True)
+                sigs.append(bucket[i])
+            else:
+                bits.append(False)
+        if not sigs:
+            return None
+        return T.SyncCommitteeContribution(
+            slot=slot, beacon_block_root=beacon_block_root,
+            subcommittee_index=subcommittee_index,
+            aggregation_bits=bits,
+            signature=bls.aggregate_signatures(sigs))
+
+    def prune(self, min_slot: int) -> None:
+        with self._lock:
+            for k in [k for k in self._messages if k[0] < min_slot]:
+                del self._messages[k]

@@ -1,0 +1,167 @@
+"""The tracker itself (eth1/src/{service,block_cache,deposit_cache}.rs)."""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..specs.chain_spec import ChainSpec
+from ..specs.constants import DEPOSIT_CONTRACT_TREE_DEPTH
+from ..ssz import htr, mix_in_length
+from ..ssz.merkle_proof import MerkleTree
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    parent_hash: bytes
+    timestamp: int
+    deposit_count: int
+    deposit_root: bytes
+
+
+@dataclass
+class DepositLog:
+    index: int
+    deposit_data: object        # T.DepositData
+
+
+class MockEth1Endpoint:
+    """In-process eth1 chain for tests/devnets (the reference's
+    eth1 test doubles)."""
+
+    def __init__(self, spec: ChainSpec, T):
+        self.spec = spec
+        self.T = T
+        self.blocks: list[Eth1Block] = []
+        self.logs: list[DepositLog] = []
+        self._tree = MerkleTree(DEPOSIT_CONTRACT_TREE_DEPTH)
+        genesis = Eth1Block(0, b"\xe1" + b"\x00" * 31, b"\x00" * 32,
+                            0, 0, mix_in_length(self._tree.hash(), 0))
+        self.blocks.append(genesis)
+
+    def add_block(self, timestamp: int | None = None,
+                  deposits: list | None = None) -> Eth1Block:
+        for dd in deposits or []:
+            self.logs.append(DepositLog(len(self.logs), dd))
+            self._tree.push_leaf(htr(dd))
+        prev = self.blocks[-1]
+        blk = Eth1Block(
+            number=prev.number + 1,
+            hash=bytes([0xE1, prev.number + 1 & 0xFF]) + b"\x11" * 30,
+            parent_hash=prev.hash,
+            timestamp=(timestamp if timestamp is not None
+                       else prev.timestamp + self.spec.seconds_per_eth1_block),
+            deposit_count=len(self.logs),
+            deposit_root=mix_in_length(self._tree.hash(), len(self.logs)))
+        self.blocks.append(blk)
+        return blk
+
+    # endpoint API the service polls
+    def latest_block_number(self) -> int:
+        return self.blocks[-1].number
+
+    def block_by_number(self, n: int) -> Eth1Block | None:
+        return self.blocks[n] if 0 <= n < len(self.blocks) else None
+
+    def deposit_logs_in_range(self, start: int, end: int) -> list[DepositLog]:
+        return [l for l in self.logs if start <= l.index < end]
+
+
+class Eth1Service:
+    def __init__(self, spec: ChainSpec, T, endpoint):
+        self.spec = spec
+        self.T = T
+        self.endpoint = endpoint
+        self.block_cache: list[Eth1Block] = []
+        self.deposit_tree = MerkleTree(DEPOSIT_CONTRACT_TREE_DEPTH)
+        self.deposit_logs: list[DepositLog] = []
+        self._lock = threading.Lock()
+
+    # -- polling (service.rs update loop) ------------------------------------
+
+    def update(self) -> None:
+        with self._lock:
+            head = self.endpoint.latest_block_number()
+            follow = self.spec.eth1_follow_distance
+            target = max(0, head - follow)
+            known = self.block_cache[-1].number if self.block_cache else -1
+            for n in range(known + 1, target + 1):
+                blk = self.endpoint.block_by_number(n)
+                if blk is None:
+                    break
+                self.block_cache.append(blk)
+            # import new deposit logs up to the followed deposit count
+            if self.block_cache:
+                count = self.block_cache[-1].deposit_count
+                have = len(self.deposit_logs)
+                for log in self.endpoint.deposit_logs_in_range(have, count):
+                    self.deposit_logs.append(log)
+                    self.deposit_tree.push_leaf(htr(log.deposit_data))
+
+    # -- eth1 data votes (get_eth1_vote) -------------------------------------
+
+    def eth1_data_for_block(self, state) -> object:
+        """Majority vote within the voting period, else the latest followed
+        block's eth1 data; falls back to the state's current value."""
+        with self._lock:
+            if not self.block_cache:
+                return state.eth1_data
+            period_start = self._voting_period_start_timestamp(state)
+            candidates = [b for b in self.block_cache
+                          if b.timestamp <= period_start]
+            best = candidates[-1] if candidates else self.block_cache[-1]
+            new_data = self.T.Eth1Data(
+                deposit_root=best.deposit_root,
+                deposit_count=best.deposit_count,
+                block_hash=best.hash)
+            # never vote to decrease the deposit count
+            if new_data.deposit_count < state.eth1_data.deposit_count:
+                return state.eth1_data
+            # majority of existing votes wins
+            tally: dict = {}
+            for v in state.eth1_data_votes:
+                key = htr(v)
+                tally[key] = tally.get(key, 0) + 1
+            if tally:
+                top_root = max(tally, key=tally.get)
+                for v in state.eth1_data_votes:
+                    if htr(v) == top_root and \
+                            v.deposit_count >= state.eth1_data.deposit_count:
+                        if tally[top_root] * 2 > len(state.eth1_data_votes):
+                            return v
+            return new_data
+
+    def _voting_period_start_timestamp(self, state) -> int:
+        p = self.spec.preset
+        slots = p.epochs_per_eth1_voting_period * p.slots_per_epoch
+        period_start_slot = state.slot - state.slot % slots
+        return state.genesis_time + period_start_slot * \
+            self.spec.seconds_per_slot - \
+            self.spec.eth1_follow_distance * self.spec.seconds_per_eth1_block
+
+    # -- deposits for inclusion ----------------------------------------------
+
+    def deposits_for_block(self, state) -> list:
+        """Deposits the next block MUST include (with proofs against the
+        state's eth1_data.deposit_root)."""
+        p = self.spec.preset
+        start = state.eth1_deposit_index
+        count = min(p.max_deposits,
+                    state.eth1_data.deposit_count - start)
+        if count <= 0:
+            return []
+        with self._lock:
+            if len(self.deposit_logs) < start + count:
+                return []
+            # proof tree at the voted deposit_count
+            tree = MerkleTree(DEPOSIT_CONTRACT_TREE_DEPTH)
+            for log in self.deposit_logs[:state.eth1_data.deposit_count]:
+                tree.push_leaf(htr(log.deposit_data))
+            out = []
+            for i in range(start, start + count):
+                proof = tree.generate_proof(i) + [
+                    state.eth1_data.deposit_count.to_bytes(32, "little")]
+                out.append(self.T.Deposit(
+                    proof=proof, data=self.deposit_logs[i].deposit_data))
+        return out

