@@ -96,12 +96,21 @@ class ValidatorRegistry:
         self.withdrawable_epoch = np.zeros(n, dtype=np.uint64)
         self._dirty = True
         self._root_cache: bytes | None = None
+        # device-resident leaf-word cache (the milhouse-style dirty-leaf
+        # tracking): None = rebuild everything; a set = only those
+        # validator rows need re-encoding + scatter
+        self._device_leaves = None
+        self._dirty_rows: set[int] | None = None
 
     def __len__(self) -> int:
         return self.pubkeys.shape[0]
 
-    def mark_dirty(self) -> None:
+    def mark_dirty(self, row: int | None = None) -> None:
         self._dirty = True
+        if row is None:
+            self._dirty_rows = None        # full rebuild
+        elif self._dirty_rows is not None:
+            self._dirty_rows.add(row)
 
     def index_of(self, pubkey: bytes) -> int | None:
         """Pubkey -> validator index (the ValidatorPubkeyCache analog,
@@ -158,7 +167,7 @@ class ValidatorRegistry:
             col[i] = np.frombuffer(value, np.uint8)
         else:
             col[i] = value
-        self.mark_dirty()
+        self.mark_dirty(int(i))
 
     def copy(self) -> "ValidatorRegistry":
         out = ValidatorRegistry.__new__(ValidatorRegistry)
@@ -166,6 +175,11 @@ class ValidatorRegistry:
             setattr(out, c, getattr(self, c).copy())
         out._dirty = self._dirty
         out._root_cache = self._root_cache
+        # the device cache is immutable (jax arrays) — share it; dirty-row
+        # sets must not be shared
+        out._device_leaves = self._device_leaves
+        out._dirty_rows = (set(self._dirty_rows)
+                           if self._dirty_rows is not None else None)
         return out
 
     # -- merkleization -------------------------------------------------------
@@ -175,28 +189,66 @@ class ValidatorRegistry:
         return np.frombuffer(arr.astype("<u8").tobytes(),
                              dtype=">u4").reshape(n, 2).astype(np.uint32)
 
-    def validator_leaf_words(self) -> np.ndarray:
-        """u32[N*8, 8]: the 8 field chunks per validator, pubkey pre-hashed."""
+    def validator_leaf_words(self, rows: np.ndarray | None = None
+                             ) -> np.ndarray:
+        """u32[R*8, 8]: the 8 field chunks per validator (pubkey pre-hashed),
+        for all validators or a row subset."""
         from ..ops import sha256 as k
-        n = len(self)
+
+        def col(a):
+            return a if rows is None else a[rows]
+
+        n = len(self) if rows is None else len(rows)
         # pubkey root: hash64 of pubkey(48) || zeros(16)
         pk_blocks = np.zeros((n, 64), dtype=np.uint8)
-        pk_blocks[:, :48] = self.pubkeys
+        pk_blocks[:, :48] = col(self.pubkeys)
         pk_words = np.frombuffer(pk_blocks.tobytes(), dtype=">u4").reshape(
             n, 16).astype(np.uint32)
         pk_roots = np.asarray(k.hash64(pk_words))
         chunks = np.zeros((n, 8, 8), dtype=np.uint32)
         chunks[:, 0] = pk_roots
         chunks[:, 1] = np.frombuffer(
-            self.withdrawal_credentials.tobytes(),
+            np.ascontiguousarray(col(self.withdrawal_credentials)).tobytes(),
             dtype=">u4").reshape(n, 8).astype(np.uint32)
-        chunks[:, 2, :2] = self._u64_words(self.effective_balance)
-        chunks[:, 3, 0] = (self.slashed.astype(np.uint32) << 24)
-        chunks[:, 4, :2] = self._u64_words(self.activation_eligibility_epoch)
-        chunks[:, 5, :2] = self._u64_words(self.activation_epoch)
-        chunks[:, 6, :2] = self._u64_words(self.exit_epoch)
-        chunks[:, 7, :2] = self._u64_words(self.withdrawable_epoch)
+
+        def u64w(a):
+            return np.frombuffer(
+                np.ascontiguousarray(col(a)).astype("<u8").tobytes(),
+                dtype=">u4").reshape(n, 2).astype(np.uint32)
+
+        chunks[:, 2, :2] = u64w(self.effective_balance)
+        chunks[:, 3, 0] = (col(self.slashed).astype(np.uint32) << 24)
+        chunks[:, 4, :2] = u64w(self.activation_eligibility_epoch)
+        chunks[:, 5, :2] = u64w(self.activation_epoch)
+        chunks[:, 6, :2] = u64w(self.exit_epoch)
+        chunks[:, 7, :2] = u64w(self.withdrawable_epoch)
         return chunks.reshape(n * 8, 8)
+
+    def _refresh_device_leaves(self):
+        """Keep u32[N*8, 8] leaf words device-resident; re-encode + scatter
+        only dirty rows (milhouse-style O(diff) updates; the steady-state
+        1M-validator rehash then moves no column data host->device)."""
+        from ..ops import sha256 as k
+        import jax.numpy as jnp
+        n = len(self)
+        full = (self._device_leaves is None or self._dirty_rows is None
+                or int(self._device_leaves.shape[0]) != n * 8)
+        if full:
+            self._device_leaves = k.jnp_asarray(self.validator_leaf_words())
+        elif self._dirty_rows:
+            rows = np.fromiter(self._dirty_rows, dtype=np.int64)
+            # pad to a power of two with repeats of rows[0] (idempotent
+            # scatter) to bound the number of compiled shapes
+            target = 1 << (len(rows) - 1).bit_length()
+            if target != len(rows):
+                rows = np.concatenate(
+                    [rows, np.full(target - len(rows), rows[0])])
+            words = self.validator_leaf_words(rows)  # [R*8, 8]
+            flat = (rows[:, None] * 8 + np.arange(8)).reshape(-1)
+            self._device_leaves = self._device_leaves.at[
+                jnp.asarray(flat)].set(k.jnp_asarray(words))
+        self._dirty_rows = set()
+        return self._device_leaves
 
     def hash_tree_root(self, registry_limit: int) -> bytes:
         if not self._dirty and self._root_cache is not None:
@@ -207,7 +259,7 @@ class ValidatorRegistry:
             depth = (registry_limit - 1).bit_length()
             root = mix_in_length(ZERO_HASHES[depth], 0)
         else:
-            nodes = k.jnp_asarray(self.validator_leaf_words())
+            nodes = self._refresh_device_leaves()
             for _ in range(3):  # 8 field chunks -> 1 root per validator
                 nodes = k.hash_pairs(nodes)
             root_words = k.merkleize_words(nodes, registry_limit)

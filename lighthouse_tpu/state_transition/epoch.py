@@ -301,9 +301,14 @@ def _process_effective_balance_updates(state: BeaconState) -> None:
     needs = (balances + down < eb) | (eb + up < balances)
     new_eb = np.minimum(balances - balances % inc, max_eb)
     updated = np.where(needs, new_eb, eb).astype(np.uint64)
-    if not np.array_equal(updated, v.effective_balance):
+    changed = np.flatnonzero(updated != v.effective_balance)
+    if len(changed):
         v.effective_balance = updated
-        v.mark_dirty()
+        if len(changed) * 8 < len(v):
+            for i in changed:
+                v.mark_dirty(int(i))
+        else:
+            v.mark_dirty()
 
 
 def _process_slashings_reset(state: BeaconState) -> None:
