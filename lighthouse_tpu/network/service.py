@@ -70,6 +70,8 @@ class NetworkService:
         self.gossip.subscribe(Topic.ATTESTER_SLASHING)
         for subnet in range(chain.spec.preset.max_committees_per_slot):
             self.gossip.subscribe(Topic.attestation_subnet(subnet))
+        for subnet in range(4):
+            self.gossip.subscribe(Topic.sync_subnet(subnet))
 
         self.rpc.register("status", self._handle_status)
         self.rpc.register("ping", lambda peer, p: {"seq": 1})
@@ -202,6 +204,11 @@ class NetworkService:
                     chain.T.SignedAggregateAndProof.ssz_type, data)
                 v = chain.verify_aggregated_attestation_for_gossip(agg)
                 return "accept", v
+            if topic.startswith("sync_committee_"):
+                msg = deserialize(chain.T.SyncCommitteeMessage.ssz_type,
+                                  data)
+                chain.sync_committee_pool.verify_and_add_message(msg)
+                return "accept", None
             return "accept", None
         except BlockError as e:
             if e.kind in ("parent_unknown",):
@@ -276,3 +283,7 @@ class NetworkService:
     def publish_attestation(self, attestation, subnet: int = 0) -> None:
         data = serialize(type(attestation).ssz_type, attestation)
         self.gossip.publish(Topic.attestation_subnet(subnet), data)
+
+    def publish_sync_committee_message(self, msg, subnet: int = 0) -> None:
+        data = serialize(type(msg).ssz_type, msg)
+        self.gossip.publish(Topic.sync_subnet(subnet), data)

@@ -1,0 +1,183 @@
+"""Multi-node in-process simulator.
+
+Equivalent of /root/reference/testing/simulator (basic_sim.rs:29,
+local_network.rs:107, checks.rs): N beacon nodes (production objects) on
+real TCP loopback with validators split across per-node validator clients,
+asserting liveness, full participation, sync and finalization — the
+"multi-node without a real cluster" tier of SURVEY.md §4.
+
+Run directly:  python -m lighthouse_tpu.testing.simulator --nodes 3
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from dataclasses import dataclass, field
+
+from ..api import ApiBackend
+from ..chain import BeaconChainHarness
+from ..crypto import bls
+from ..network import NetworkService
+from ..specs import minimal_spec
+from ..validator_client import (
+    BeaconNodeFallback, ValidatorClient, ValidatorStore,
+)
+
+
+@dataclass
+class LocalNode:
+    harness: BeaconChainHarness
+    network: NetworkService
+    backend: ApiBackend
+    vc: ValidatorClient | None = None
+
+
+class GossipingBackend(ApiBackend):
+    """API publish also floods the gossip network (http_api/src/
+    publish_blocks.rs -> network channel behavior)."""
+
+    def __init__(self, chain, network: NetworkService):
+        super().__init__(chain)
+        self.network = network
+
+    def publish_block(self, signed_block) -> None:
+        super().publish_block(signed_block)
+        self.network.publish_block(signed_block)
+
+    def publish_attestation(self, attestation) -> None:
+        super().publish_attestation(attestation)
+        self.network.publish_attestation(attestation)
+
+    def publish_sync_committee_message(self, msg) -> None:
+        super().publish_sync_committee_message(msg)
+        self.network.publish_sync_committee_message(msg)
+
+
+@dataclass
+class CheckResult:
+    name: str
+    ok: bool
+    detail: str = ""
+
+
+class LocalNetwork:
+    """node_test_rig LocalNetwork equivalent."""
+
+    def __init__(self, spec, node_count: int, validator_count: int = 64):
+        bls.set_backend("fake")
+        self.spec = spec
+        self.validator_count = validator_count
+        self.nodes: list[LocalNode] = []
+        first_port = None
+        for i in range(node_count):
+            h = BeaconChainHarness(spec, validator_count)
+            net = NetworkService(h.chain)
+            backend = GossipingBackend(h.chain, net)
+            net.start()
+            node = LocalNode(h, net, backend)
+            self.nodes.append(node)
+            if first_port is None:
+                first_port = net.port
+            else:
+                net.dial("127.0.0.1", first_port)
+        # split validators across nodes, each slice driven by that node's VC
+        per = validator_count // node_count
+        for i, node in enumerate(self.nodes):
+            store = ValidatorStore(
+                spec, node.harness.chain.genesis_validators_root)
+            lo = i * per
+            hi = validator_count if i == node_count - 1 else (i + 1) * per
+            for sk in node.harness.secret_keys[lo:hi]:
+                store.add_validator(sk)
+            node.vc = ValidatorClient(spec, store,
+                                      BeaconNodeFallback([node.backend]))
+
+    def _wait_convergence(self, timeout: float = 5.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            heads = {n.harness.chain.recompute_head() for n in self.nodes}
+            if len(heads) == 1:
+                return
+            time.sleep(0.02)
+
+    def run_slots(self, num_slots: int) -> None:
+        """Each slot mirrors the real duty schedule: propose at 0s,
+        attest + sync-sign at slot/3 (after block propagation),
+        aggregate at 2*slot/3."""
+        for _ in range(num_slots):
+            for node in self.nodes:
+                node.harness.advance_slot()
+            slot = self.nodes[0].harness.chain.slot()
+            for node in self.nodes:
+                vc = node.vc
+                epoch = slot // self.spec.preset.slots_per_epoch
+                if epoch not in vc._duties or epoch + 1 not in vc._duties:
+                    vc.update_duties(epoch)
+                vc.propose_if_due(slot)
+            self._wait_convergence()
+            for node in self.nodes:
+                node.vc.attest(slot)
+                node.vc.sync_committee_duty(slot)
+            for node in self.nodes:
+                node.vc.aggregate(slot)
+            self._wait_convergence()
+
+    # -- checks (testing/simulator/src/checks.rs) ----------------------------
+
+    def checks(self, min_epochs: int) -> list[CheckResult]:
+        out = []
+        heads = {n.harness.chain.head().head_block_root
+                 for n in self.nodes}
+        out.append(CheckResult("all_nodes_agree_on_head", len(heads) == 1,
+                               f"{len(heads)} distinct heads"))
+        slot = self.nodes[0].harness.chain.slot()
+        head_slot = self.nodes[0].harness.chain.head().head_state.slot
+        out.append(CheckResult(
+            "liveness", head_slot >= slot - 1,
+            f"head {head_slot} vs clock {slot}"))
+        fin = self.nodes[0].harness.chain.finalized_checkpoint()[0]
+        out.append(CheckResult(
+            "finalization", fin >= max(0, min_epochs - 2),
+            f"finalized epoch {fin}"))
+        blocks_per_node = [n.vc.published_blocks for n in self.nodes]
+        out.append(CheckResult(
+            "all_nodes_proposed", all(b > 0 for b in blocks_per_node),
+            f"{blocks_per_node}"))
+        # sync-aggregate participation on recent blocks
+        chain = self.nodes[0].harness.chain
+        body = chain.head().head_block.message.body
+        if hasattr(body, "sync_aggregate"):
+            bits = body.sync_aggregate.sync_committee_bits
+            rate = sum(1 for b in bits if b) / max(1, len(bits))
+            out.append(CheckResult("sync_participation", rate > 0.5,
+                                   f"{rate:.2f}"))
+        return out
+
+    def stop(self) -> None:
+        for n in self.nodes:
+            n.network.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=2)
+    ap.add_argument("--validators", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=4)
+    args = ap.parse_args(argv)
+    spec = minimal_spec(altair_fork_epoch=0)
+    net = LocalNetwork(spec, args.nodes, args.validators)
+    try:
+        net.run_slots(args.epochs * spec.preset.slots_per_epoch)
+        results = net.checks(args.epochs)
+    finally:
+        net.stop()
+    ok = True
+    for r in results:
+        print(f"[{'PASS' if r.ok else 'FAIL'}] {r.name}: {r.detail}")
+        ok &= r.ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Multi-node simulator: the whole-client tier (basic_sim.rs equivalent)."""
+import pytest
+
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.testing.simulator import LocalNetwork
+
+
+def test_vc_failover_between_nodes():
+    """fallback_sim.rs equivalent: the VC keeps performing duties when its
+    primary BN dies, via BeaconNodeFallback re-sorting."""
+    from lighthouse_tpu.api import ApiBackend
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.validator_client import (
+        BeaconNodeFallback, ValidatorClient, ValidatorStore,
+    )
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = BeaconChainHarness(spec, 64)
+    good = ApiBackend(h.chain)
+
+    class DeadBackend:
+        def is_healthy(self):
+            raise ConnectionError("down")
+
+        def __getattr__(self, name):
+            def dead(*a, **k):
+                raise ConnectionError("down")
+            return dead
+
+    nodes = BeaconNodeFallback([DeadBackend(), good])
+    store = ValidatorStore(spec, h.chain.genesis_validators_root)
+    for sk in h.secret_keys:
+        store.add_validator(sk)
+    vc = ValidatorClient(spec, store, nodes)
+    for _ in range(spec.preset.slots_per_epoch):
+        h.advance_slot()
+        vc.on_slot(h.chain.slot())
+        h.chain.recompute_head()
+    assert vc.published_blocks >= spec.preset.slots_per_epoch - 1
+    nodes.check_health()
+    # healthy node re-sorted to the front
+    assert nodes.nodes[0] is good
+
+
+def test_two_node_network_finalizes():
+    spec = minimal_spec(altair_fork_epoch=0)
+    net = LocalNetwork(spec, node_count=2, validator_count=64)
+    try:
+        net.run_slots(4 * spec.preset.slots_per_epoch)
+        results = net.checks(min_epochs=4)
+    finally:
+        net.stop()
+    failures = [r for r in results if not r.ok]
+    assert not failures, failures
