@@ -53,6 +53,27 @@ def main(argv=None):
     am_new.add_argument("--out", default="keystores")
     am_new.add_argument("--password", default="")
 
+    dev = sub.add_parser("dev", help="lcli-style dev tools")
+    dev_sub = dev.add_subparsers(dest="dev_cmd", required=True)
+    tr = dev_sub.add_parser("transition-blocks")
+    tr.add_argument("--pre", required=True, help="pre-state SSZ (fork byte"
+                    " + state)")
+    tr.add_argument("--block", required=True)
+    tr.add_argument("--out", required=True)
+    tr.add_argument("--no-signature-verification", action="store_true")
+    sk = dev_sub.add_parser("skip-slots")
+    sk.add_argument("--pre", required=True)
+    sk.add_argument("--slots", type=int, required=True)
+    sk.add_argument("--out", required=True)
+    sr = dev_sub.add_parser("state-root")
+    sr.add_argument("--state", required=True)
+    br = dev_sub.add_parser("block-root")
+    br.add_argument("--block", required=True)
+    gi = dev_sub.add_parser("interop-genesis")
+    gi.add_argument("--validators", type=int, default=64)
+    gi.add_argument("--genesis-time", type=int, default=0)
+    gi.add_argument("--out", required=True)
+
     dbm = sub.add_parser("database_manager", aliases=["db"])
     dbm.add_argument("--datadir", required=True)
     dbm_sub = dbm.add_subparsers(dest="db_cmd", required=True)
@@ -73,7 +94,75 @@ def main(argv=None):
         return _run_account_manager(spec, args)
     if args.cmd in ("database_manager", "db"):
         return _run_database_manager(spec, args)
+    if args.cmd == "dev":
+        return _run_dev(spec, args)
     return 1
+
+
+def _load_state(spec, path):
+    from .containers import get_types
+    from .containers.state import BeaconState
+    from .specs.chain_spec import ForkName
+    raw = open(path, "rb").read()
+    return BeaconState.from_ssz_bytes(raw[1:], get_types(spec.preset), spec,
+                                      ForkName(raw[0]))
+
+
+def _dump_state(state, path):
+    with open(path, "wb") as f:
+        f.write(bytes([state.fork_name.value]) + state.serialize())
+
+
+def _run_dev(spec, args):
+    from .containers import get_types
+    from .specs.chain_spec import ForkName
+    from .ssz import deserialize, htr
+    T = get_types(spec.preset)
+    if args.dev_cmd == "transition-blocks":
+        from .state_transition import per_block_processing, process_slots
+        from .state_transition.block import VerifySignatures
+        state = _load_state(spec, args.pre)
+        braw = open(args.block, "rb").read()
+        signed = deserialize(
+            T.SignedBeaconBlock[ForkName(braw[0])].ssz_type, braw[1:])
+        process_slots(state, signed.message.slot)
+        per_block_processing(
+            state, signed,
+            VerifySignatures.FALSE if args.no_signature_verification
+            else VerifySignatures.TRUE)
+        _dump_state(state, args.out)
+        print(json.dumps({"post_state_root":
+                          "0x" + state.hash_tree_root().hex()}))
+    elif args.dev_cmd == "skip-slots":
+        from .state_transition import process_slots
+        state = _load_state(spec, args.pre)
+        process_slots(state, state.slot + args.slots)
+        _dump_state(state, args.out)
+        print(json.dumps({"slot": state.slot,
+                          "state_root":
+                          "0x" + state.hash_tree_root().hex()}))
+    elif args.dev_cmd == "state-root":
+        state = _load_state(spec, args.state)
+        print(json.dumps({"slot": state.slot, "fork":
+                          state.fork_name.name.lower(),
+                          "root": "0x" + state.hash_tree_root().hex()}))
+    elif args.dev_cmd == "block-root":
+        braw = open(args.block, "rb").read()
+        signed = deserialize(
+            T.SignedBeaconBlock[ForkName(braw[0])].ssz_type, braw[1:])
+        print(json.dumps({"slot": signed.message.slot,
+                          "root": "0x" + htr(signed.message).hex()}))
+    elif args.dev_cmd == "interop-genesis":
+        from .crypto import bls
+        from .state_transition import interop_genesis_state
+        state = interop_genesis_state(
+            spec, [bls.keygen_interop(i) for i in range(args.validators)],
+            genesis_time=args.genesis_time)
+        _dump_state(state, args.out)
+        print(json.dumps({"validators": args.validators,
+                          "genesis_validators_root":
+                          "0x" + state.genesis_validators_root.hex()}))
+    return 0
 
 
 def _run_beacon_node(spec, args):

@@ -114,6 +114,12 @@ class BeaconChain:
         store.store_genesis(self.genesis_block_root, genesis_state)
         if genesis_block is not None:
             store.put_block(self.genesis_block_root, genesis_block)
+            if genesis_state.slot > 0:
+                # checkpoint-sync anchor: history before this block is
+                # backfilled by SyncManager.backfill
+                store.set_backfill_anchor(
+                    genesis_block.message.slot,
+                    genesis_block.message.parent_root)
 
     # -- time / status -------------------------------------------------------
 

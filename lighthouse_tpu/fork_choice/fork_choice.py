@@ -93,12 +93,13 @@ class ForkChoice:
 
     def __init__(self, spec: ChainSpec, genesis_block_root: bytes,
                  anchor_state: BeaconState):
+        """Anchored at the given block (genesis OR a checkpoint-sync anchor):
+        spec get_forkchoice_store — justified = finalized = the anchor
+        checkpoint itself, since nothing older exists in the proto array."""
         self.spec = spec
-        justified = _ckpt(anchor_state.current_justified_checkpoint)
-        finalized = _ckpt(anchor_state.finalized_checkpoint)
-        if justified[0] == 0:
-            justified = (0, genesis_block_root)
-            finalized = (0, genesis_block_root)
+        anchor_epoch = anchor_state.slot // spec.preset.slots_per_epoch
+        justified = (anchor_epoch, genesis_block_root)
+        finalized = (anchor_epoch, genesis_block_root)
         self.proto_array = ProtoArray(justified, finalized)
         self.votes: list[VoteTracker] = []
         self.balances = anchor_state.validators.effective_balance.copy()

@@ -68,6 +68,58 @@ class SyncManager:
         self.state = "synced"
         return imported
 
+    # -- backfill (checkpoint-sync history, sync/backfill_sync/mod.rs) -------
+
+    def backfill(self, batch_slots: int | None = None) -> int:
+        """Download blocks BACKWARDS from the anchor to genesis, verifying
+        hash-chain linkage into the trusted anchor (historical_blocks.rs:
+        signature verification is subsumed by the parent-root chain into a
+        finalized root here; batched sig-recheck is a TODO). Returns blocks
+        stored."""
+        chain = self.chain
+        anchor = chain.store.backfill_anchor()
+        if anchor is None:
+            return 0
+        anchor_slot, expected_root = anchor
+        if anchor_slot == 0:
+            return 0
+        peer_info = self.peers.best_peer_for_sync()
+        if peer_info is None:
+            return 0
+        peer = self.rpc.transport.peers.get(peer_info.node_id)
+        if peer is None:
+            return 0
+        spe = chain.spec.preset.slots_per_epoch
+        batch_slots = batch_slots or EPOCHS_PER_BATCH * spe
+        stored = 0
+        while anchor_slot > 0:
+            start = max(0, anchor_slot - batch_slots)
+            try:
+                resp = self.rpc.request(
+                    peer, "beacon_blocks_by_range",
+                    {"start_slot": start, "count": anchor_slot - start})
+            except (TimeoutError, RuntimeError):
+                self.peers.report(peer_info.node_id, "timeout")
+                break
+            blocks = [b for b in (self._decode_block(x) for x in resp or [])
+                      if b is not None]
+            # verify the batch links into the trusted root, newest first
+            for sb in reversed(blocks):
+                root = htr(sb.message)
+                if root != expected_root:
+                    self.peers.report(peer_info.node_id, "bad_segment")
+                    return stored
+                chain.store.put_block(root, sb)
+                chain.store.freezer_put_block_root(sb.message.slot, root)
+                expected_root = sb.message.parent_root
+                stored += 1
+            anchor_slot = (blocks[0].message.slot if blocks else start)
+            chain.store.set_backfill_anchor(anchor_slot, expected_root)
+            if start == 0:
+                chain.store.set_backfill_anchor(0, expected_root)
+                break
+        return stored
+
     # -- block lookups -------------------------------------------------------
 
     def lookup_unknown_parent(self, block_root: bytes, peer_id: str,

@@ -204,6 +204,17 @@ class HotColdDB:
     def genesis_block_root(self) -> bytes | None:
         return self._get_meta(b"genesis_block_root")
 
+    # -- backfill anchor (checkpoint sync: oldest known block) ---------------
+
+    def set_backfill_anchor(self, slot: int, parent_root: bytes) -> None:
+        self._put_meta(b"backfill", struct.pack("<Q", slot) + parent_root)
+
+    def backfill_anchor(self) -> tuple[int, bytes] | None:
+        raw = self._get_meta(b"backfill")
+        if raw is None:
+            return None
+        return struct.unpack("<Q", raw[:8])[0], raw[8:40]
+
     # -- freezer -------------------------------------------------------------
 
     def freezer_put_block_root(self, slot: int, block_root: bytes) -> None:
