@@ -301,13 +301,23 @@ class ApiBackend:
                 raise ApiError(400, f"aggregate rejected: {e}")
 
     def get_sync_duties(self, epoch: int, indices: list[int]) -> list[int]:
-        """Validator indices (of the requested set) in the current sync
-        committee."""
+        """Validator indices (of the requested set) in the sync committee
+        serving `epoch` — period-aware: current committee for the head's
+        period, next_sync_committee for the following period."""
         st = self.chain.head().head_state
         if st.current_sync_committee is None:
             return []
+        period_len = self.chain.spec.preset.epochs_per_sync_committee_period
+        head_period = st.current_epoch() // period_len
+        want_period = epoch // period_len
+        if want_period == head_period:
+            committee = st.current_sync_committee
+        elif want_period == head_period + 1:
+            committee = st.next_sync_committee
+        else:
+            raise ApiError(400, f"epoch {epoch} outside known sync periods")
         members = set()
-        for pk in st.current_sync_committee.pubkeys:
+        for pk in committee.pubkeys:
             i = st.validators.index_of(pk)
             if i is not None:
                 members.add(i)

@@ -92,6 +92,12 @@ def _make_handler(backend: ApiBackend):
              [int(i) for i in q.get("id", [])], int(m[1]))}),
         (re.compile(r"^/eth/v1/validator/aggregate_attestation$"),
          lambda m, q: {"data": _aggregate_ssz(backend, q)}),
+        (re.compile(r"^/eth/v1/validator/sync_duties/(\d+)$"),
+         lambda m, q: {"data": backend.get_sync_duties(
+             int(m[1]), [int(i) for i in q.get("id", [])])}),
+        (re.compile(r"^/lighthouse/head_root$"),
+         lambda m, q: {"data": {
+             "root": "0x" + backend.head_root().hex()}}),
     ]
 
     class Handler(BaseHTTPRequestHandler):
@@ -138,9 +144,11 @@ def _make_handler(backend: ApiBackend):
                 except ApiError as e:
                     return self._json(e.status, {"message": str(e)})
                 raw = serialize(type(block).ssz_type, block)
+                fork_name = backend.chain.spec.fork_name_at_slot(
+                    slot).name.lower()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
-                self.send_header("Eth-Consensus-Version", "phase0")
+                self.send_header("Eth-Consensus-Version", fork_name)
                 self.send_header("Content-Length", str(len(raw)))
                 self.end_headers()
                 self.wfile.write(raw)
@@ -192,12 +200,26 @@ def _make_handler(backend: ApiBackend):
                          "validator_committee_index": str(pos)}
                         for s, ci, vi, cl, pos in duties]})
                 if url.path == "/eth/v1/beacon/pool/attestations":
-                    att = deserialize(chain.T.Attestation.ssz_type, body)
+                    from ..specs.chain_spec import ForkName
+                    fork = chain.spec.fork_name_at_slot(chain.slot())
+                    att_t = (chain.T.AttestationElectra.ssz_type
+                             if fork >= ForkName.ELECTRA
+                             else chain.T.Attestation.ssz_type)
+                    att = deserialize(att_t, body)
                     backend.publish_attestation(att)
                     return self._json(200, {})
+                if url.path == "/eth/v1/beacon/pool/sync_committees":
+                    msg = deserialize(
+                        chain.T.SyncCommitteeMessage.ssz_type, body)
+                    backend.publish_sync_committee_message(msg)
+                    return self._json(200, {})
                 if url.path == "/eth/v1/validator/aggregate_and_proofs":
-                    agg = deserialize(
-                        chain.T.SignedAggregateAndProof.ssz_type, body)
+                    from ..specs.chain_spec import ForkName
+                    fork = chain.spec.fork_name_at_slot(chain.slot())
+                    agg_t = (chain.T.SignedAggregateAndProofElectra.ssz_type
+                             if fork >= ForkName.ELECTRA
+                             else chain.T.SignedAggregateAndProof.ssz_type)
+                    agg = deserialize(agg_t, body)
                     backend.publish_aggregate(agg)
                     return self._json(200, {})
                 return self._json(404, {"message": "route not found"})

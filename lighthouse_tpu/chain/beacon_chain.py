@@ -251,6 +251,7 @@ class BeaconChain:
                 self._monitored_epoch = state.current_epoch()
                 self.validator_monitor.on_epoch_transition(
                     self._monitored_epoch - 1, state)
+            self.validator_monitor.note_state(state)
             for slashing in block.body.attester_slashings:
                 self.fork_choice.on_attester_slashing(slashing.attestation_1)
             self.store.put_block(block_root, ep.signed_block)
@@ -414,6 +415,10 @@ class BeaconChain:
         self.observed_aggregators.prune(fin_slot)
         self.observed_aggregates.prune(fin_slot)
         self.observed_sync_contributors.prune(fin_slot)
+        self.sync_committee_pool.prune(fin_slot)
+        self.validator_monitor.prune(max(0, fin_epoch - 4))
+        self.block_times = {r: t for r, t in self.block_times.items()
+                            if t.get("slot", 0) > fin_slot}
         self.fork_choice.prune()
         self.events.emit("finalized_checkpoint",
                          {"epoch": fin_epoch, "root": fin_root})

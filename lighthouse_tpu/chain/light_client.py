@@ -142,14 +142,20 @@ class LightClientServerCache:
         participants = sum(1 for b in agg.sync_committee_bits if b)
         if participants == 0:
             return
-        attested = _header_for(post_state)
+        # the aggregate in block N signs block N's PARENT — the attested
+        # header/state are the parent's (spec: signature_slot > attested.slot)
+        attested_state = self.chain._state_for(
+            signed_block.message.parent_root)
+        if attested_state is None:
+            return
+        attested = _header_for(attested_state)
         self.latest_optimistic_update = LightClientOptimisticUpdate(
             attested_header=attested, sync_aggregate=agg,
             signature_slot=signed_block.message.slot)
-        fin_root = post_state.finalized_checkpoint.root
+        fin_root = attested_state.finalized_checkpoint.root
         fin_block = self.chain.store.get_block(fin_root)
         if fin_block is not None:
-            leaf, branch, _g = finalized_root_branch(post_state)
+            leaf, branch, _g = finalized_root_branch(attested_state)
             fin_hdr = self.chain.T.BeaconBlockHeader(
                 slot=fin_block.message.slot,
                 proposer_index=fin_block.message.proposer_index,

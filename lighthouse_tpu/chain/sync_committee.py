@@ -37,14 +37,20 @@ class SyncCommitteePool:
         if not positions:
             raise AttestationError("not_in_sync_committee",
                                    str(msg.validator_index))
-        if chain.observed_sync_contributors.observe(msg.slot,
-                                                    msg.validator_index):
+        # check-before / observe-after signature verification, so a forged
+        # message cannot block the validator's real one (same discipline as
+        # attestation_verification)
+        if chain.observed_sync_contributors.has_been_observed(
+                msg.slot, msg.validator_index):
             raise AttestationError(PRIOR_SEEN, "sync contributor")
         domain = get_domain(state, DOMAIN_SYNC_COMMITTEE,
                             msg.slot // state.slots_per_epoch)
         signing_root = compute_signing_root(msg.beacon_block_root, domain)
         if not bls.verify(vpk, signing_root, msg.signature):
             raise AttestationError(BAD_SIGNATURE, "sync message")
+        if chain.observed_sync_contributors.observe(msg.slot,
+                                                    msg.validator_index):
+            raise AttestationError(PRIOR_SEEN, "sync contributor")
         with self._lock:
             bucket = self._messages[(msg.slot, msg.beacon_block_root)]
             for p in positions:

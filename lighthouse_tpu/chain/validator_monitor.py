@@ -56,20 +56,39 @@ class ValidatorMonitor:
                     s.attestation_hits += 1
                     s.inclusion_distance_sum += distance
 
+    _pending: tuple | None = None    # (epoch, participation snapshot)
+
     def on_epoch_transition(self, epoch: int, state) -> None:
-        """Score misses for the completed epoch using participation flags."""
+        """Called when the chain enters epoch+1. Scoring for `epoch` is
+        DEFERRED until the next transition: late attestations for `epoch`
+        can still land throughout epoch+1, so we score the previous pending
+        snapshot now and stash this epoch's final flags for later."""
         from ..specs.chain_spec import ForkName
         if state.fork_name < ForkName.ALTAIR:
             return
-        part = state.previous_epoch_participation
-        for v in (self.registered if not self.auto
-                  else range(len(state.validators))):
-            if v >= len(part):
-                continue
-            if not (int(part[v]) & 0b010):  # timely target unset
-                self.summaries[epoch][v].attestation_misses += 1
-                log.warning("validator %d missed target attestation in "
-                            "epoch %d", v, epoch)
+        if self._pending is not None:
+            done_epoch, part = self._pending
+            for v in (self.registered if not self.auto
+                      else range(len(part))):
+                if v >= len(part):
+                    continue
+                if not (int(part[v]) & 0b010):  # timely target unset
+                    self.summaries[done_epoch][v].attestation_misses += 1
+                    log.warning("validator %d missed target attestation in "
+                                "epoch %d", v, done_epoch)
+        # previous_epoch_participation currently holds `epoch`'s flags and
+        # keeps absorbing its late attestations during epoch+1; note_state
+        # refreshes the snapshot on every import until the next transition
+        self._pending = (epoch, state.previous_epoch_participation)
+
+    def note_state(self, state) -> None:
+        """Refresh the pending epoch's flag snapshot (late inclusions)."""
+        from ..specs.chain_spec import ForkName
+        if self._pending is None or state.fork_name < ForkName.ALTAIR:
+            return
+        ep, _ = self._pending
+        if state.current_epoch() == ep + 1:
+            self._pending = (ep, state.previous_epoch_participation)
 
     # -- queries -------------------------------------------------------------
 

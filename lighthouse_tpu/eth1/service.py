@@ -76,6 +76,7 @@ class Eth1Service:
         self.block_cache: list[Eth1Block] = []
         self.deposit_tree = MerkleTree(DEPOSIT_CONTRACT_TREE_DEPTH)
         self.deposit_logs: list[DepositLog] = []
+        self._proof_trees: dict[int, MerkleTree] = {}  # deposit_count -> tree
         self._lock = threading.Lock()
 
     # -- polling (service.rs update loop) ------------------------------------
@@ -154,10 +155,15 @@ class Eth1Service:
         with self._lock:
             if len(self.deposit_logs) < start + count:
                 return []
-            # proof tree at the voted deposit_count
-            tree = MerkleTree(DEPOSIT_CONTRACT_TREE_DEPTH)
-            for log in self.deposit_logs[:state.eth1_data.deposit_count]:
-                tree.push_leaf(htr(log.deposit_data))
+            # proof tree snapshot at the voted deposit_count (cached —
+            # rebuilding per proposal was O(total deposits) of hashing)
+            want = state.eth1_data.deposit_count
+            tree = self._proof_trees.get(want)
+            if tree is None:
+                tree = MerkleTree(DEPOSIT_CONTRACT_TREE_DEPTH)
+                for log in self.deposit_logs[:want]:
+                    tree.push_leaf(htr(log.deposit_data))
+                self._proof_trees = {want: tree}  # keep one snapshot
             out = []
             for i in range(start, start + count):
                 proof = tree.generate_proof(i) + [

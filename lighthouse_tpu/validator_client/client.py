@@ -145,7 +145,10 @@ class ValidatorClient:
             if not members:
                 return
             head_root = self.nodes.first_success("head_root")
-        except Exception:
+        except Exception as e:
+            import logging
+            logging.getLogger("lighthouse_tpu.vc").warning(
+                "sync committee duty skipped: %r", e)
             return
         for vi in members:
             pk = self._pubkey_for(vi)

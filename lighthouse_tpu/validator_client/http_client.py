@@ -123,6 +123,19 @@ class BeaconNodeHttpClient(BeaconNodeInterface):
         out = self._req("GET", "/eth/v1/validator/fork_version")
         return bytes.fromhex(out["data"]["version"][2:])
 
+    def get_sync_duties(self, epoch: int, indices: list[int]) -> list[int]:
+        qs = "&".join(f"id={i}" for i in indices)
+        out = self._req("GET", f"/eth/v1/validator/sync_duties/{epoch}?{qs}")
+        return [int(i) for i in out["data"]]
+
+    def head_root(self) -> bytes:
+        out = self._req("GET", "/lighthouse/head_root")
+        return bytes.fromhex(out["data"]["root"][2:])
+
+    def publish_sync_committee_message(self, msg) -> None:
+        self._req("POST", "/eth/v1/beacon/pool/sync_committees",
+                  body=serialize(type(msg).ssz_type, msg))
+
     def seen_liveness(self, indices: list[int], epoch: int):
         qs = "&".join(f"id={i}" for i in indices)
         out = self._req("GET", f"/eth/v1/validator/liveness/{epoch}?{qs}")

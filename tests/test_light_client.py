@@ -62,7 +62,9 @@ def test_electra_gindices():
 def test_server_cache_produces_updates():
     spec = minimal_spec(altair_fork_epoch=0)
     h = BeaconChainHarness(spec, 64)
-    h.extend_chain(4 * spec.preset.slots_per_epoch)
+    # finality first reaches the state at the epoch-4 boundary; the attested
+    # (parent) state sees it one block later — run into epoch 5
+    h.extend_chain(5 * spec.preset.slots_per_epoch)
     cache = h.chain.light_client_cache
     boot = cache.produce_bootstrap(h.chain.head().head_block_root)
     assert boot is not None
@@ -73,11 +75,13 @@ def test_server_cache_produces_updates():
     assert sum(1 for b in opt.sync_aggregate.sync_committee_bits if b) > 0
     fin = cache.latest_finality_update
     assert fin is not None
-    # the finality proof inside the update verifies against the attested state
-    st = h.chain._state_for(h.chain.head().head_block_root)
+    # the aggregate signs the head's PARENT: signature_slot > attested.slot
+    assert fin.signature_slot > fin.attested_header.beacon.slot
+    # and the finality proof verifies against the ATTESTED (parent) state
+    attested_state = h.chain._state_for(
+        h.chain.head().head_block.message.parent_root)
     assert verify_merkle_proof_gindex(
-        fin.finalized_header.beacon.parent_root * 0 +
-        h.chain.head().head_state.finalized_checkpoint.root,
-        fin.finality_branch, 105, st.hash_tree_root())
+        attested_state.finalized_checkpoint.root,
+        fin.finality_branch, 105, attested_state.hash_tree_root())
     upd = cache.produce_update(h.chain.head().head_block_root)
     assert upd is not None and len(upd.next_sync_committee_branch) == 5
