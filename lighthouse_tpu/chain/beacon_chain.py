@@ -442,6 +442,18 @@ class BeaconChain:
             self.store.migrate_database(
                 fin_slot, fin_block.message.state_root, fin_root, filled)
         self.op_pool.prune(self.canonical_head.head_state)
+        self.persist()
+
+    def persist(self) -> None:
+        """Write fork choice + head + op pool for restart resume
+        (persisted_fork_choice.rs / persist_head, beacon_chain.rs:612)."""
+        from .persistence import persist_chain
+        persist_chain(self)
+
+    def resume(self) -> bool:
+        """FromStore boot: restore fork choice/head/op pool."""
+        from .persistence import resume_chain
+        return resume_chain(self)
 
     # -- per-slot tasks ------------------------------------------------------
 

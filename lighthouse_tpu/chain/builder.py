@@ -58,6 +58,17 @@ class BeaconChainBuilder:
         self._genesis_block = signed_block
         return self
 
+    def resume_from_store(self, store: HotColdDB) -> "BeaconChainBuilder":
+        """ClientGenesis::FromStore (client/src/config.rs:33): boot from a
+        previously-anchored database."""
+        anchor = store.anchor_state()
+        if anchor is None:
+            raise ValueError("store has no anchor to resume from")
+        self._store = store
+        self._genesis_state = anchor
+        self._resume = True
+        return self
+
     def build(self) -> BeaconChain:
         assert self._genesis_state is not None, "genesis required"
         store = self._store or HotColdDB(MemoryStore(), MemoryStore(),
@@ -65,6 +76,9 @@ class BeaconChainBuilder:
         clock = self._clock or SystemTimeSlotClock(
             self._genesis_state.genesis_time, self.spec.seconds_per_slot)
         el = self._el or MockExecutionLayer()
-        return BeaconChain(self.spec, store, clock, el,
-                           self._genesis_state, self._genesis_block,
-                           self._config)
+        chain = BeaconChain(self.spec, store, clock, el,
+                            self._genesis_state, self._genesis_block,
+                            self._config)
+        if getattr(self, "_resume", False):
+            chain.resume()
+        return chain

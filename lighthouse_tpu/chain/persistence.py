@@ -1,0 +1,173 @@
+"""Chain persistence: fork choice, head, op pool — restart resume.
+
+Equivalent of the reference's persisted_fork_choice.rs / persist_head
+(beacon_chain.rs:612,662) + operation_pool/persistence.rs: everything needed
+to resume after a restart is written to the hot DB under ITEM keys, and
+`ClientGenesis::FromStore` boots from it.
+"""
+from __future__ import annotations
+
+import json
+
+from ..fork_choice import ForkChoice
+from ..fork_choice.proto_array import ExecutionStatus, ProtoNode, VoteTracker
+
+FORK_CHOICE_KEY = b"fork_choice"
+HEAD_KEY = b"head"
+OP_POOL_KEY = b"op_pool"
+
+
+def _hex(b: bytes | None) -> str | None:
+    return b.hex() if b is not None else None
+
+
+def _unhex(s) -> bytes | None:
+    return bytes.fromhex(s) if s is not None else None
+
+
+def persist_fork_choice(chain) -> None:
+    fc = chain.fork_choice
+    pa = fc.proto_array
+    doc = {
+        "justified": [fc.justified_checkpoint[0],
+                      _hex(fc.justified_checkpoint[1])],
+        "finalized": [fc.finalized_checkpoint[0],
+                      _hex(fc.finalized_checkpoint[1])],
+        "u_justified": [fc.unrealized_justified_checkpoint[0],
+                        _hex(fc.unrealized_justified_checkpoint[1])],
+        "u_finalized": [fc.unrealized_finalized_checkpoint[0],
+                        _hex(fc.unrealized_finalized_checkpoint[1])],
+        "current_slot": fc.current_slot,
+        "equivocating": sorted(fc.equivocating_indices),
+        "votes": [[_hex(v.current_root), _hex(v.next_root), v.next_epoch]
+                  for v in fc.votes],
+        "nodes": [{
+            "slot": n.slot, "root": _hex(n.root),
+            "parent": n.parent, "state_root": _hex(n.state_root),
+            "target": _hex(n.target_root),
+            "jc": [n.justified_checkpoint[0], _hex(n.justified_checkpoint[1])],
+            "fc": [n.finalized_checkpoint[0], _hex(n.finalized_checkpoint[1])],
+            "weight": n.weight,
+            "best_child": n.best_child, "best_descendant": n.best_descendant,
+            "exec": n.execution_status.value,
+            "exec_hash": _hex(n.execution_block_hash),
+        } for n in pa.nodes],
+    }
+    chain.store.put_item(FORK_CHOICE_KEY, json.dumps(doc).encode())
+    chain.store.put_item(HEAD_KEY, chain.head().head_block_root)
+
+
+def restore_fork_choice(chain) -> bool:
+    raw = chain.store.get_item(FORK_CHOICE_KEY)
+    if raw is None:
+        return False
+    doc = json.loads(raw)
+    fc = chain.fork_choice
+    fc.justified_checkpoint = (doc["justified"][0],
+                               _unhex(doc["justified"][1]))
+    fc.finalized_checkpoint = (doc["finalized"][0],
+                               _unhex(doc["finalized"][1]))
+    fc.unrealized_justified_checkpoint = (doc["u_justified"][0],
+                                          _unhex(doc["u_justified"][1]))
+    fc.unrealized_finalized_checkpoint = (doc["u_finalized"][0],
+                                          _unhex(doc["u_finalized"][1]))
+    fc.current_slot = doc["current_slot"]
+    fc.equivocating_indices = set(doc["equivocating"])
+    fc.votes = [VoteTracker(_unhex(c), _unhex(nx), e)
+                for c, nx, e in doc["votes"]]
+    pa = fc.proto_array
+    pa.nodes = []
+    pa.indices = {}
+    for nd in doc["nodes"]:
+        node = ProtoNode(
+            slot=nd["slot"], root=_unhex(nd["root"]), parent=nd["parent"],
+            state_root=_unhex(nd["state_root"]),
+            target_root=_unhex(nd["target"]),
+            justified_checkpoint=(nd["jc"][0], _unhex(nd["jc"][1])),
+            finalized_checkpoint=(nd["fc"][0], _unhex(nd["fc"][1])),
+            weight=nd["weight"], best_child=nd["best_child"],
+            best_descendant=nd["best_descendant"],
+            execution_status=ExecutionStatus(nd["exec"]),
+            execution_block_hash=_unhex(nd["exec_hash"]))
+        pa.indices[node.root] = len(pa.nodes)
+        pa.nodes.append(node)
+    pa.justified_checkpoint = fc.justified_checkpoint
+    pa.finalized_checkpoint = fc.finalized_checkpoint
+    return True
+
+
+def persist_op_pool(chain) -> None:
+    from ..ssz import serialize
+    pool = chain.op_pool
+    T = chain.T
+    with pool._lock:
+        atts = [a for bucket in pool._attestations.values() for a in bucket]
+        doc = {
+            "attestations": [serialize(type(a).ssz_type, a).hex()
+                             for a in atts],
+            "att_electra": [hasattr(a, "committee_bits") for a in atts],
+            "exits": [serialize(T.SignedVoluntaryExit.ssz_type, e).hex()
+                      for e in pool._voluntary_exits.values()],
+            "proposer_slashings": [
+                serialize(T.ProposerSlashing.ssz_type, s).hex()
+                for s in pool._proposer_slashings.values()],
+            "bls_changes": [
+                serialize(T.SignedBLSToExecutionChange.ssz_type, c).hex()
+                for c in pool._bls_changes.values()],
+        }
+    chain.store.put_item(OP_POOL_KEY, json.dumps(doc).encode())
+
+
+def restore_op_pool(chain) -> int:
+    from ..ssz import deserialize
+    raw = chain.store.get_item(OP_POOL_KEY)
+    if raw is None:
+        return 0
+    doc = json.loads(raw)
+    T = chain.T
+    n = 0
+    for hexa, is_electra in zip(doc["attestations"],
+                                doc.get("att_electra", [])):
+        t = (T.AttestationElectra if is_electra else T.Attestation).ssz_type
+        chain.op_pool.insert_attestation(deserialize(t, bytes.fromhex(hexa)))
+        n += 1
+    for hexe in doc["exits"]:
+        chain.op_pool.insert_voluntary_exit(
+            deserialize(T.SignedVoluntaryExit.ssz_type, bytes.fromhex(hexe)))
+        n += 1
+    for hexs in doc["proposer_slashings"]:
+        chain.op_pool.insert_proposer_slashing(
+            deserialize(T.ProposerSlashing.ssz_type, bytes.fromhex(hexs)))
+        n += 1
+    for hexc in doc["bls_changes"]:
+        chain.op_pool.insert_bls_to_execution_change(
+            deserialize(T.SignedBLSToExecutionChange.ssz_type,
+                        bytes.fromhex(hexc)))
+        n += 1
+    return n
+
+
+def persist_chain(chain) -> None:
+    persist_fork_choice(chain)
+    persist_op_pool(chain)
+
+
+def resume_chain(chain) -> bool:
+    """Restore fork choice + head + op pool from the store (FromStore boot).
+    Returns True when prior state existed."""
+    if not restore_fork_choice(chain):
+        return False
+    restore_op_pool(chain)
+    head_root = chain.store.get_item(HEAD_KEY)
+    if head_root is not None and \
+            chain.fork_choice.contains_block(head_root):
+        head_block = chain.store.get_block(head_root)
+        head_state = (chain.store.get_hot_state(head_block.message.state_root)
+                      if head_block else None)
+        if head_block is not None and head_state is not None:
+            from .beacon_chain import CanonicalHead
+            with chain._lock:
+                chain.canonical_head = CanonicalHead(head_root, head_block,
+                                                     head_state)
+            chain._cache_snapshot(head_root, head_state)
+    return True

@@ -84,9 +84,13 @@ class ClientBuilder:
         else:
             store = HotColdDB(MemoryStore(), MemoryStore(), self.spec)
 
-        # beacon chain (genesis / checkpoint sync)
+        # beacon chain (resume / genesis / checkpoint sync)
         cb = BeaconChainBuilder(self.spec).store(store)
-        if cfg.checkpoint_sync_state is not None:
+        if cfg.datadir and cfg.checkpoint_sync_state is None and \
+                store.anchor_state() is not None:
+            # ClientGenesis::FromStore — restart resume
+            cb.resume_from_store(store)
+        elif cfg.checkpoint_sync_state is not None:
             from ..containers import get_types
             from ..containers.state import BeaconState
             from ..specs.chain_spec import ForkName

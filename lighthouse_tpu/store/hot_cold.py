@@ -200,6 +200,20 @@ class HotColdDB:
         self.freezer_put_state(genesis_state.slot, genesis_state)
         self.freezer_put_block_root(genesis_state.slot, genesis_block_root)
         self._put_meta(b"genesis_block_root", genesis_block_root)
+        self._put_meta(b"anchor_slot",
+                       struct.pack("<Q", genesis_state.slot))
+
+    def anchor_state(self) -> BeaconState | None:
+        """The state this DB was anchored on (FromStore resume boots here)."""
+        raw = self._get_meta(b"anchor_slot")
+        if raw is None:
+            return None
+        slot = struct.unpack("<Q", raw)[0]
+        data = self.cold.get(FREEZER_STATE + struct.pack(">Q", slot))
+        if data is None:
+            return None
+        return BeaconState.from_ssz_bytes(data[1:], self.T, self.spec,
+                                          ForkName(data[0]))
 
     def genesis_block_root(self) -> bytes | None:
         return self._get_meta(b"genesis_block_root")

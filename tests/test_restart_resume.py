@@ -1,0 +1,65 @@
+"""Restart resume (SURVEY.md §5.4): a node rebuilt FromStore continues the
+chain with the same head, fork choice, and op pool."""
+import os
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainBuilder, BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.store import HotColdDB, NativeKvStore
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def test_from_store_resume(tmp_path):
+    spec = minimal_spec()
+
+    def open_store():
+        return HotColdDB(NativeKvStore(tmp_path / "hot.db"),
+                         NativeKvStore(tmp_path / "cold.db"), spec)
+
+    store = open_store()
+    h = BeaconChainHarness(spec, 64, store=store)
+    h.extend_chain(4 * spec.preset.slots_per_epoch)
+    chain_a = h.chain
+    chain_a.persist()
+    head_a = chain_a.head().head_block_root
+    fin_a = chain_a.finalized_checkpoint()
+    pool_n = chain_a.op_pool.num_attestations()
+    assert fin_a[0] >= 1
+    store.hot.close()
+    store.cold.close()
+
+    # "restart": a brand-new chain object resumed from disk only
+    store2 = open_store()
+    clock = ManualSlotClock(0, spec.seconds_per_slot,
+                            current_slot=chain_a.slot())
+    chain_b = (BeaconChainBuilder(spec)
+               .resume_from_store(store2)
+               .slot_clock(clock)
+               .build())
+    assert chain_b.head().head_block_root == head_a
+    assert chain_b.finalized_checkpoint() == fin_a
+    assert chain_b.op_pool.num_attestations() == pool_n
+    assert chain_b.fork_choice.contains_block(head_a)
+
+    # the resumed chain keeps importing blocks produced on top of its head
+    h2 = BeaconChainHarness.__new__(BeaconChainHarness)
+    # reuse harness signing over the resumed chain
+    h2.spec = spec
+    h2.sh = h.sh
+    h2.secret_keys = h.secret_keys
+    h2.clock = clock
+    h2.chain = chain_b
+    h2.T = chain_b.T
+    h2.advance_slot()
+    signed, _ = h2.produce_signed_block()
+    root = chain_b.process_block(signed)
+    assert chain_b.head().head_block_root == root
+    assert chain_b.head().head_state.slot == chain_a.slot() + 1
