@@ -53,6 +53,10 @@ def main(argv=None):
     am_new.add_argument("--out", default="keystores")
     am_new.add_argument("--password", default="")
 
+    bnode = sub.add_parser("boot_node", help="standalone discovery bootnode")
+    bnode.add_argument("--host", default="127.0.0.1")
+    bnode.add_argument("--boot-port", type=int, default=9100)
+
     dev = sub.add_parser("dev", help="lcli-style dev tools")
     dev_sub = dev.add_subparsers(dest="dev_cmd", required=True)
     tr = dev_sub.add_parser("transition-blocks")
@@ -96,6 +100,10 @@ def main(argv=None):
         return _run_database_manager(spec, args)
     if args.cmd == "dev":
         return _run_dev(spec, args)
+    if args.cmd == "boot_node":
+        from .network.discovery import main as boot_main
+        return boot_main(["--host", args.host, "--port",
+                          str(args.boot_port)])
     return 1
 
 

@@ -128,10 +128,12 @@ class ClientBuilder:
 
         # network, fed through the priority beacon processor
         from ..beacon_processor import BeaconProcessor
+        from ..network.discovery import Discovery
         client.processor = BeaconProcessor(num_workers=os.cpu_count() or 4)
         client.network = NetworkService(client.chain, cfg.network,
                                         processor=client.processor)
         client.network.start()
+        client.discovery = Discovery(client.network)
 
         # http api + metrics
         if cfg.http_enabled:
@@ -152,6 +154,11 @@ class ClientBuilder:
                 if slot != last:
                     last = slot
                     chain.per_slot_task()
+                    if slot % 8 == 0:
+                        try:
+                            client.discovery.discover_once()
+                        except Exception:
+                            pass
                     if client.slasher is not None:
                         client.slasher.process_queued(chain.epoch())
                     head = chain.head()

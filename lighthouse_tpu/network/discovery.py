@@ -1,0 +1,151 @@
+"""Peer discovery + standalone bootnode.
+
+Equivalent of the reference's discv5 discovery (lighthouse_network/src/
+discovery) and the boot_node binary (boot_node/src/server.rs), over the
+framed-TCP transport instead of UDP Kademlia: every node serves a
+`discovery_peers` RPC returning its known peer addresses; nodes poll it to
+top up toward target_peers. A bootnode is just a NetworkService-less
+Transport+RPC that only serves the address book.
+
+Run standalone:  python -m lighthouse_tpu.network.discovery --port 9100
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+from .rpc import RpcHandler
+from .transport import Transport
+
+
+class AddressBook:
+    def __init__(self):
+        self._addrs: dict[str, tuple[str, int]] = {}
+        self._lock = threading.Lock()
+
+    def record(self, node_id: str, host: str, port: int) -> None:
+        with self._lock:
+            self._addrs[node_id] = (host, port)
+
+    def sample(self, exclude: set[str], limit: int = 16) -> list:
+        with self._lock:
+            return [[nid, h, p] for nid, (h, p) in self._addrs.items()
+                    if nid not in exclude][:limit]
+
+
+class Discovery:
+    """Attach to a NetworkService: serve + poll peer exchange."""
+
+    def __init__(self, service, listen_port: int | None = None):
+        self.service = service
+        self.book = AddressBook()
+        self.listen_port = listen_port or service.port
+        service.rpc.register("discovery_peers", self._handle)
+        # learn dialable addresses from peers as they identify themselves
+        self._identify()
+
+    def _identify(self) -> None:
+        self.service.rpc.register(
+            "discovery_identify",
+            lambda peer, p: self._record_identify(peer, p))
+
+    def _record_identify(self, peer, payload) -> dict:
+        try:
+            self.book.record(peer.node_id, payload["host"],
+                             int(payload["port"]))
+        except (KeyError, ValueError, TypeError):
+            pass
+        return {"ok": True}
+
+    def _handle(self, peer, payload) -> list:
+        exclude = {peer.node_id, self.service.transport.node_id}
+        return self.book.sample(exclude)
+
+    def advertise(self, peer) -> None:
+        """Tell a peer our dialable address."""
+        try:
+            self.service.rpc.request(peer, "discovery_identify", {
+                "host": self.service.transport.host,
+                "port": self.listen_port}, timeout=3.0)
+        except (TimeoutError, RuntimeError):
+            pass
+
+    def discover_once(self) -> int:
+        """Ask each connected peer for more peers; dial new ones until
+        target_peers. Returns new connections made."""
+        svc = self.service
+        known = set(svc.transport.peers) | {svc.transport.node_id}
+        made = 0
+        for peer in list(svc.transport.peers.values()):
+            self.advertise(peer)
+            try:
+                found = svc.rpc.request(peer, "discovery_peers", {},
+                                        timeout=3.0)
+            except (TimeoutError, RuntimeError):
+                continue
+            for nid, host, port in found or []:
+                if nid in known:
+                    continue
+                if len(svc.transport.peers) >= svc.peers.target_peers:
+                    return made
+                if svc.dial(host, int(port)) is not None:
+                    known.add(nid)
+                    made += 1
+        return made
+
+
+class BootNode:
+    """Standalone address-book server (boot_node binary equivalent)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.transport = Transport(host, port)
+        self.rpc = RpcHandler(self.transport)
+        self.book = AddressBook()
+        self.transport.on_frame = \
+            lambda peer, kind, payload: self.rpc.handle_frame(peer, kind,
+                                                              payload)
+        self.rpc.register("discovery_peers",
+                          lambda peer, p: self.book.sample({peer.node_id}))
+        self.rpc.register("discovery_identify", self._identify)
+        self.rpc.register("status", lambda peer, p: p)  # echo, stay neutral
+        self.rpc.register("ping", lambda peer, p: {"seq": 0})
+
+    def _identify(self, peer, payload) -> dict:
+        try:
+            self.book.record(peer.node_id, payload["host"],
+                             int(payload["port"]))
+        except (KeyError, ValueError, TypeError):
+            pass
+        return {"ok": True}
+
+    @property
+    def port(self) -> int:
+        return self.transport.port
+
+    def start(self) -> None:
+        self.transport.start()
+
+    def stop(self) -> None:
+        self.transport.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9100)
+    args = ap.parse_args(argv)
+    node = BootNode(args.host, args.port)
+    node.start()
+    print(f"bootnode listening on {args.host}:{node.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""Peer discovery through a bootnode: three nodes find each other."""
+import time
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.network import NetworkService
+from lighthouse_tpu.network.discovery import BootNode, Discovery
+from lighthouse_tpu.specs import minimal_spec
+
+
+def test_bootnode_peer_exchange():
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    boot = BootNode()
+    boot.start()
+    services = []
+    discos = []
+    try:
+        for _ in range(3):
+            h = BeaconChainHarness(spec, 64)
+            svc = NetworkService(h.chain)
+            svc.start()
+            disco = Discovery(svc)
+            peer = svc.dial("127.0.0.1", boot.port)
+            assert peer is not None
+            disco.advertise(peer)
+            services.append(svc)
+            discos.append(disco)
+        # each node asks the bootnode for peers and dials them
+        total_new = 0
+        for disco in discos:
+            total_new += disco.discover_once()
+        time.sleep(0.3)
+        # node 0 and node 2 should now be connected even though neither
+        # dialed the other directly
+        mesh_ok = sum(
+            1 for svc in services
+            if len([p for p in svc.transport.peers.values()]) >= 2)
+        assert total_new >= 2
+        assert mesh_ok >= 2, [len(s.transport.peers) for s in services]
+    finally:
+        for svc in services:
+            svc.stop()
+        boot.stop()
