@@ -105,6 +105,8 @@ class BeaconChain:
         self.light_client_cache = LightClientServerCache(self)
         from .sync_committee import SyncCommitteePool
         self.sync_committee_pool = SyncCommitteePool(self)
+        from .data_availability import DataAvailabilityChecker
+        self.data_availability_checker = DataAvailabilityChecker(self.T)
         self.block_times: dict[bytes, dict] = {}
         from .validator_monitor import ValidatorMonitor
         self.validator_monitor = ValidatorMonitor(self)
@@ -212,7 +214,31 @@ class BeaconChain:
         sv = blk_verify.into_signature_verified(
             self, signed_block, block_root, proposal_already_verified)
         ep = blk_verify.into_execution_pending(self, sv)
+        # deneb+: blob availability gate (data_availability_checker.rs)
+        commitments = getattr(block.body, "blob_kzg_commitments", None)
+        if commitments:
+            ready = self.data_availability_checker.put_pending_block(
+                block_root, ep, len(commitments))
+            if ready is None:
+                from .errors import AVAILABILITY_PENDING
+                raise BlockError(AVAILABILITY_PENDING, block_root.hex())
+            ep = ready
         return self.import_block(ep)
+
+    def process_blob_sidecar(self, sidecar) -> bytes | None:
+        """Gossip blob intake; imports the parent block when it completes.
+        Returns the imported block root, or None while still pending."""
+        from .errors import INVALID_BLOCK
+        hdr = sidecar.signed_block_header.message
+        block_root = htr(hdr)
+        if self.observed_blob_sidecars.observe(hdr.slot, hdr.proposer_index,
+                                               sidecar.index):
+            return None
+        ready = self.data_availability_checker.put_sidecar(block_root,
+                                                           sidecar)
+        if ready is not None:
+            return self.import_block(ready)
+        return None
 
     def import_block(self, ep) -> bytes:
         """beacon_chain.rs:3449 import_block: fork choice + store + head."""

@@ -1,0 +1,106 @@
+"""Blob availability: inclusion proofs, gating, completion (deneb)."""
+import numpy as np
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness, BlockError
+from lighthouse_tpu.chain.data_availability import (
+    commitment_inclusion_proof, produce_sidecars, verify_commitment_inclusion,
+)
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.ssz import htr
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def _deneb_harness():
+    spec = minimal_spec(altair_fork_epoch=0, bellatrix_fork_epoch=0,
+                        capella_fork_epoch=0, deneb_fork_epoch=0)
+    return BeaconChainHarness(spec, 64)
+
+
+def _block_with_blobs(h, n_blobs):
+    """Produce a valid deneb block carrying n_blobs commitments."""
+    chain = h.chain
+    kzg = chain.data_availability_checker.kzg
+    blobs = [bytes([i + 1]) * (32 * h.T.preset.field_elements_per_blob)
+             for i in range(n_blobs)]
+    commitments = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+    h.advance_slot()
+    slot = chain.slot()
+    from lighthouse_tpu.state_transition import process_slots
+    from lighthouse_tpu.state_transition.helpers import (
+        get_beacon_proposer_index,
+    )
+    state = chain.head().head_state.copy()
+    process_slots(state, slot)
+    proposer = get_beacon_proposer_index(state, slot)
+    reveal = h.randao_reveal(state, slot, proposer)
+    block, _post = chain.produce_block(reveal, slot)
+    block.body.blob_kzg_commitments = commitments
+    # recompute state root with the commitments included
+    post = state.copy()
+    unsigned = h.T.SignedBeaconBlock[state.fork_name](
+        message=block, signature=bls.INFINITY_SIGNATURE)
+    from lighthouse_tpu.state_transition import per_block_processing
+    from lighthouse_tpu.state_transition.block import VerifySignatures
+    per_block_processing(post, unsigned, VerifySignatures.FALSE)
+    block.state_root = post.hash_tree_root()
+    signed = h.sign_block(block, state)
+    return signed, blobs
+
+
+def test_inclusion_proof_roundtrip():
+    h = _deneb_harness()
+    signed, blobs = _block_with_blobs(h, 2)
+    T = h.T
+    sidecars = produce_sidecars(T, signed, blobs,
+                                h.chain.data_availability_checker.kzg)
+    body_root = htr(signed.message.body)
+    p = T.preset
+    for sc in sidecars:
+        assert len(sc.kzg_commitment_inclusion_proof) == \
+            p.kzg_commitment_inclusion_proof_depth
+        assert verify_commitment_inclusion(T, sc, body_root)
+    # tampered commitment fails
+    bad = sidecars[0].copy()
+    bad.kzg_commitment = b"\x99" * 48
+    assert not verify_commitment_inclusion(T, bad, body_root)
+    # wrong index fails
+    bad2 = sidecars[0].copy()
+    bad2.index = 1
+    assert not verify_commitment_inclusion(T, bad2, body_root)
+
+
+def test_block_gated_until_blobs_arrive():
+    from lighthouse_tpu.chain.errors import AVAILABILITY_PENDING
+    h = _deneb_harness()
+    chain = h.chain
+    signed, blobs = _block_with_blobs(h, 2)
+    root = htr(signed.message)
+    sidecars = produce_sidecars(h.T, signed, blobs,
+                                chain.data_availability_checker.kzg)
+    with pytest.raises(BlockError) as e:
+        chain.process_block(signed)
+    assert e.value.kind == AVAILABILITY_PENDING
+    assert chain.process_blob_sidecar(sidecars[0]) is None  # still pending
+    imported = chain.process_blob_sidecar(sidecars[1])      # completes
+    assert imported == root
+    assert chain.head().head_block_root == root
+
+
+def test_blobs_before_block():
+    h = _deneb_harness()
+    chain = h.chain
+    signed, blobs = _block_with_blobs(h, 1)
+    root = htr(signed.message)
+    sidecars = produce_sidecars(h.T, signed, blobs,
+                                chain.data_availability_checker.kzg)
+    assert chain.process_blob_sidecar(sidecars[0]) is None
+    # block arrives after its blobs -> imports immediately
+    imported = chain.process_block(signed)
+    assert imported == root
