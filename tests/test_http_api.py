@@ -70,6 +70,31 @@ def test_routes(server):
     assert status == 404
 
 
+def test_sse_events_stream(server):
+    """/eth/v1/events: head events arrive over a live SSE connection."""
+    import socket
+    h, srv = server
+    sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+    sock.sendall(b"GET /eth/v1/events?topics=head HTTP/1.1\r\n"
+                 b"Host: localhost\r\nAccept: text/event-stream\r\n\r\n")
+    # read headers
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += sock.recv(1024)
+    assert b"200" in buf.split(b"\r\n")[0]
+    assert b"text/event-stream" in buf
+    # trigger a head event
+    h.advance_slot()
+    signed, _ = h.produce_signed_block()
+    h.chain.process_block(signed)
+    sock.settimeout(10)
+    data = buf.split(b"\r\n\r\n", 1)[1]
+    while b"event: head" not in data:
+        data += sock.recv(4096)
+    assert b"data: " in data
+    sock.close()
+
+
 def test_publish_block_roundtrip(server):
     h, srv = server
     h.advance_slot()
