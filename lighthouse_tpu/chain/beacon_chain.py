@@ -228,14 +228,21 @@ class BeaconChain:
     def process_blob_sidecar(self, sidecar) -> bytes | None:
         """Gossip blob intake; imports the parent block when it completes.
         Returns the imported block root, or None while still pending."""
-        from .errors import INVALID_BLOCK
         hdr = sidecar.signed_block_header.message
         block_root = htr(hdr)
-        if self.observed_blob_sidecars.observe(hdr.slot, hdr.proposer_index,
-                                               sidecar.index):
+        # check-before / observe-after verification: a forged sidecar must
+        # not block the real one (same discipline as attestations)
+        if self.observed_blob_sidecars.has_been_observed(
+                hdr.slot, hdr.proposer_index, sidecar.index):
             return None
         ready = self.data_availability_checker.put_sidecar(block_root,
                                                            sidecar)
+        if ready is None and not \
+                self.data_availability_checker.contains_sidecar(
+                    block_root, sidecar.index):
+            return None  # failed verification: leave unobserved
+        self.observed_blob_sidecars.observe(hdr.slot, hdr.proposer_index,
+                                            sidecar.index)
         if ready is not None:
             return self.import_block(ready)
         return None
@@ -442,6 +449,7 @@ class BeaconChain:
         self.observed_aggregates.prune(fin_slot)
         self.observed_sync_contributors.prune(fin_slot)
         self.sync_committee_pool.prune(fin_slot)
+        self.data_availability_checker.prune(fin_slot)
         self.validator_monitor.prune(max(0, fin_epoch - 4))
         self.block_times = {r: t for r, t in self.block_times.items()
                             if t.get("slot", 0) > fin_slot}

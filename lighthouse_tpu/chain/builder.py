@@ -58,14 +58,21 @@ class BeaconChainBuilder:
         self._genesis_block = signed_block
         return self
 
-    def resume_from_store(self, store: HotColdDB) -> "BeaconChainBuilder":
+    def resume_from_store(self, store: HotColdDB,
+                          anchor=None) -> "BeaconChainBuilder":
         """ClientGenesis::FromStore (client/src/config.rs:33): boot from a
-        previously-anchored database."""
-        anchor = store.anchor_state()
+        previously-anchored database. Pass `anchor` when already loaded (it
+        is a full cold-state fetch)."""
+        anchor = anchor if anchor is not None else store.anchor_state()
         if anchor is None:
             raise ValueError("store has no anchor to resume from")
         self._store = store
         self._genesis_state = anchor
+        # restore the anchor block so head_block is never None even when
+        # fork choice was never persisted (pre-first-finalization restarts)
+        root = store.genesis_block_root()
+        if root is not None:
+            self._genesis_block = store.get_block(root)
         self._resume = True
         return self
 

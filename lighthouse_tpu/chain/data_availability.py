@@ -161,6 +161,7 @@ class _PendingBlock:
     execution_pending: object
     needed: int
     sidecars: dict = field(default_factory=dict)
+    slot: int = 0
 
 
 class DataAvailabilityChecker:
@@ -175,12 +176,22 @@ class DataAvailabilityChecker:
         self._lock = threading.Lock()
 
     def verify_sidecar(self, sidecar) -> bool:
+        # index must be in range — the list-tree fold only consumes the low
+        # bits, so unbounded indices would alias and bypass the gate
+        if not 0 <= sidecar.index < \
+                self.T.preset.max_blob_commitments_per_block:
+            return False
         body_root = sidecar.signed_block_header.message.body_root
         if not verify_commitment_inclusion(self.T, sidecar, body_root):
             return False
         return self.kzg.verify_blob_kzg_proof_batch(
             [bytes(sidecar.blob)], [sidecar.kzg_commitment],
             [sidecar.kzg_proof])
+
+    def contains_sidecar(self, block_root: bytes, index: int) -> bool:
+        with self._lock:
+            entry = self._pending.get(block_root)
+            return entry is not None and index in entry.sidecars
 
     def put_pending_block(self, block_root: bytes, execution_pending,
                           needed: int):
@@ -205,7 +216,10 @@ class DataAvailabilityChecker:
             entry = self._pending.get(block_root)
             if entry is None:
                 entry = _PendingBlock(None, 1 << 30)
+                entry.slot = sidecar.signed_block_header.message.slot
                 self._pending[block_root] = entry
+                while len(self._pending) > self.MAX_PENDING:
+                    self._pending.pop(next(iter(self._pending)))
             entry.sidecars[sidecar.index] = sidecar
             return self._take_if_complete(block_root)
 
@@ -221,7 +235,7 @@ class DataAvailabilityChecker:
     def prune(self, finalized_slot: int) -> None:
         with self._lock:
             for root in [r for r, e in self._pending.items()
-                         if e.execution_pending is not None
-                         and e.execution_pending.signed_block.message.slot
-                         <= finalized_slot]:
+                         if (e.execution_pending.signed_block.message.slot
+                             if e.execution_pending is not None
+                             else e.slot) <= finalized_slot]:
                 self._pending.pop(root)

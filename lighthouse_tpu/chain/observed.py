@@ -113,6 +113,10 @@ class ObservedBlobSidecars:
         self._seen.add(key)
         return False
 
+    def has_been_observed(self, slot: int, proposer: int,
+                          index: int) -> bool:
+        return (slot, proposer, index) in self._seen
+
     def prune(self, finalized_slot: int) -> None:
         self._seen = {k for k in self._seen if k[0] > finalized_slot}
 

@@ -47,6 +47,12 @@ def persist_fork_choice(chain) -> None:
             "target": _hex(n.target_root),
             "jc": [n.justified_checkpoint[0], _hex(n.justified_checkpoint[1])],
             "fc": [n.finalized_checkpoint[0], _hex(n.finalized_checkpoint[1])],
+            "ujc": ([n.unrealized_justified_checkpoint[0],
+                     _hex(n.unrealized_justified_checkpoint[1])]
+                    if n.unrealized_justified_checkpoint else None),
+            "ufc": ([n.unrealized_finalized_checkpoint[0],
+                     _hex(n.unrealized_finalized_checkpoint[1])]
+                    if n.unrealized_finalized_checkpoint else None),
             "weight": n.weight,
             "best_child": n.best_child, "best_descendant": n.best_descendant,
             "exec": n.execution_status.value,
@@ -85,6 +91,12 @@ def restore_fork_choice(chain) -> bool:
             target_root=_unhex(nd["target"]),
             justified_checkpoint=(nd["jc"][0], _unhex(nd["jc"][1])),
             finalized_checkpoint=(nd["fc"][0], _unhex(nd["fc"][1])),
+            unrealized_justified_checkpoint=(
+                (nd["ujc"][0], _unhex(nd["ujc"][1]))
+                if nd.get("ujc") else None),
+            unrealized_finalized_checkpoint=(
+                (nd["ufc"][0], _unhex(nd["ufc"][1]))
+                if nd.get("ufc") else None),
             weight=nd["weight"], best_child=nd["best_child"],
             best_descendant=nd["best_descendant"],
             execution_status=ExecutionStatus(nd["exec"]),
@@ -111,6 +123,12 @@ def persist_op_pool(chain) -> None:
             "proposer_slashings": [
                 serialize(T.ProposerSlashing.ssz_type, s).hex()
                 for s in pool._proposer_slashings.values()],
+            "attester_slashings": [
+                serialize(type(s).ssz_type, s).hex()
+                for s in pool._attester_slashings],
+            "as_electra": [
+                "Electra" in type(s).__name__
+                for s in pool._attester_slashings],
             "bls_changes": [
                 serialize(T.SignedBLSToExecutionChange.ssz_type, c).hex()
                 for c in pool._bls_changes.values()],
@@ -138,6 +156,13 @@ def restore_op_pool(chain) -> int:
     for hexs in doc["proposer_slashings"]:
         chain.op_pool.insert_proposer_slashing(
             deserialize(T.ProposerSlashing.ssz_type, bytes.fromhex(hexs)))
+        n += 1
+    for hexs, is_electra in zip(doc.get("attester_slashings", []),
+                                doc.get("as_electra", [])):
+        t = (T.AttesterSlashingElectra if is_electra
+             else T.AttesterSlashing).ssz_type
+        chain.op_pool.insert_attester_slashing(
+            deserialize(t, bytes.fromhex(hexs)))
         n += 1
     for hexc in doc["bls_changes"]:
         chain.op_pool.insert_bls_to_execution_change(

@@ -86,10 +86,12 @@ class ClientBuilder:
 
         # beacon chain (resume / genesis / checkpoint sync)
         cb = BeaconChainBuilder(self.spec).store(store)
-        if cfg.datadir and cfg.checkpoint_sync_state is None and \
-                store.anchor_state() is not None:
+        resume_anchor = (store.anchor_state()
+                         if cfg.datadir and cfg.checkpoint_sync_state is None
+                         else None)
+        if resume_anchor is not None:
             # ClientGenesis::FromStore — restart resume
-            cb.resume_from_store(store)
+            cb.resume_from_store(store, anchor=resume_anchor)
         elif cfg.checkpoint_sync_state is not None:
             from ..containers import get_types
             from ..containers.state import BeaconState

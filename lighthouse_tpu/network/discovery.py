@@ -35,6 +35,15 @@ class AddressBook:
                     if nid not in exclude][:limit]
 
 
+def record_identify(book: AddressBook, peer, payload) -> dict:
+    """Shared identify handler (node-side and bootnode-side)."""
+    try:
+        book.record(peer.node_id, payload["host"], int(payload["port"]))
+    except (KeyError, ValueError, TypeError):
+        pass
+    return {"ok": True}
+
+
 class Discovery:
     """Attach to a NetworkService: serve + poll peer exchange."""
 
@@ -44,20 +53,9 @@ class Discovery:
         self.listen_port = listen_port or service.port
         service.rpc.register("discovery_peers", self._handle)
         # learn dialable addresses from peers as they identify themselves
-        self._identify()
-
-    def _identify(self) -> None:
-        self.service.rpc.register(
+        service.rpc.register(
             "discovery_identify",
-            lambda peer, p: self._record_identify(peer, p))
-
-    def _record_identify(self, peer, payload) -> dict:
-        try:
-            self.book.record(peer.node_id, payload["host"],
-                             int(payload["port"]))
-        except (KeyError, ValueError, TypeError):
-            pass
-        return {"ok": True}
+            lambda peer, p: record_identify(self.book, peer, p))
 
     def _handle(self, peer, payload) -> list:
         exclude = {peer.node_id, self.service.transport.node_id}
@@ -108,17 +106,11 @@ class BootNode:
                                                               payload)
         self.rpc.register("discovery_peers",
                           lambda peer, p: self.book.sample({peer.node_id}))
-        self.rpc.register("discovery_identify", self._identify)
+        self.rpc.register(
+            "discovery_identify",
+            lambda peer, p: record_identify(self.book, peer, p))
         self.rpc.register("status", lambda peer, p: p)  # echo, stay neutral
         self.rpc.register("ping", lambda peer, p: {"seq": 0})
-
-    def _identify(self, peer, payload) -> dict:
-        try:
-            self.book.record(peer.node_id, payload["host"],
-                             int(payload["port"]))
-        except (KeyError, ValueError, TypeError):
-            pass
-        return {"ok": True}
 
     @property
     def port(self) -> int:
