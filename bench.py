@@ -1,23 +1,51 @@
 """Headline benchmark (run by the driver on real TPU hardware).
 
-Prints ONE JSON line. Current primary metric: BeaconState tree_hash_root at
-1M validators on one chip (BASELINE.md north star 2: < 200 ms;
-vs_baseline = 200 / measured_ms, so >= 1.0 meets the target). The BLS batch
-metric switches in when the pairing kernel lands (ops/bls12_381).
+Prints ONE JSON line on stdout, always — even on backend failure.
+
+Round-1 post-mortem (BENCH_r01.json rc=1): the in-process jax import died
+initializing the experimental ``axon`` TPU backend and the bench emitted a
+traceback instead of JSON.  The parent process therefore never imports
+jax: it launches the measurement in a child subprocess with a bounded
+timeout, retries once on the default (TPU) platform, then falls back to a
+forced-CPU child, and finally emits an error record if everything failed.
+The child annotates the JSON with the platform it actually ran on so a
+CPU fallback can't masquerade as a TPU number.
+
+Metrics (BASELINE.md north stars):
+- default: BeaconState tree_hash_root at 1M validators (<200 ms target;
+  vs_baseline = 200/ms).
+- LHTPU_BENCH=bls: batched RLC signature verification throughput
+  (>=4x blst target; vs_baseline = sigs_per_sec / (4 * blst_sigs_per_sec)
+  would be the strict reading; we report sigs_per_sec / blst baseline so
+  >=4.0 meets the target).
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
 
 N_VALIDATORS = 1_000_000
 TARGET_MS = 200.0
 
+N_SIGS = 2048
+# blst on the reference's recommended 4-core node: ~0.38 ms/pairing
+# single-thread => ~8.7k sigs/s across 4 cores on a 10k batch (BASELINE.md);
+# the >=4x target means >= ~35k sigs/s on one chip.  When the native C++
+# pairing backend is available we measure the host baseline instead of
+# trusting this constant (see _measured_host_baseline).
+BLST_BASELINE_SIGS_PER_SEC = 8700.0
+
+
+# --------------------------------------------------------------------------
+# child: actual measurement (imports jax)
+# --------------------------------------------------------------------------
 
 def build_state_columns(n):
+    import numpy as np
     rng = np.random.default_rng(7)
     from lighthouse_tpu.containers.state import ValidatorRegistry
     vr = ValidatorRegistry.__new__(ValidatorRegistry)
@@ -40,11 +68,12 @@ def build_state_columns(n):
 
 def bench_tree_hash():
     """Cached-tree-hash semantics (update_tree_hash_cache): per-rep, mutate
-    1024 validators, then recompute the full state-root-dominant columns
-    (validators via dirty-row device scatter + full re-merkle, balances
-    fully re-packed)."""
-    from lighthouse_tpu.containers.state import _np_uint_root
+    1024 validators + 1024 balances, then recompute the state-root-dominant
+    columns.  Both columns are device-resident with dirty-row scatter."""
+    import numpy as np
+    from lighthouse_tpu.containers.state import BalancesColumn
     vr, balances = build_state_columns(N_VALIDATORS)
+    bc = BalancesColumn(balances)
     vrl = 2**40
     rng = np.random.default_rng(11)
 
@@ -52,25 +81,19 @@ def bench_tree_hash():
         rows = rng.integers(0, N_VALIDATORS, size=1024)
         for i in rows:
             vr.set_field(int(i), "effective_balance", 31 * 10**9)
+        brows = rng.integers(0, N_VALIDATORS, size=1024)
+        bc.set_many(brows, np.full(1024, 32 * 10**9, dtype=np.uint64))
         v_root = vr.hash_tree_root(vrl)
-        b_root = _np_uint_root(balances, (vrl * 8 + 31) // 32,
-                               length=N_VALIDATORS)
+        b_root = bc.hash_tree_root(vrl)
         return v_root, b_root
 
     run()  # warm up compiles + build the device-resident leaves
     times = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         run()
         times.append((time.perf_counter() - t0) * 1000)
     return min(times)
-
-
-N_SIGS = 2048
-# blst on the reference's recommended 4-core node: ~0.38 ms/pairing
-# single-thread => ~8.7k sigs/s across 4 cores on a 10k batch (BASELINE.md);
-# the >=4x target means >= ~35k sigs/s on one chip.
-BLST_BASELINE_SIGS_PER_SEC = 8700.0
 
 
 def bench_bls():
@@ -102,10 +125,8 @@ def bench_bls():
     apx, apy = k.jacobian_to_affine_fp(*pk)
     ahx, ahy = k.jacobian_to_affine_fp2(*h)
 
-    from lighthouse_tpu.crypto.bls12_381 import g1_compress
     neg = G1_GENERATOR.neg().to_affine()
 
-    import jax
     def verify(px, py, qx, qy, sx, sy, sz, rbits):
         # RLC: scale pks and sigs, aggregate sigs, n+1 pairings
         spx, spy, spz = k.g1_scalar_mul(px, py, one1, rbits)
@@ -137,24 +158,107 @@ def bench_bls():
     return n / secs
 
 
-def main():
-    import os
-    if os.environ.get("LHTPU_BENCH") == "bls":
+def _measured_host_baseline():
+    """Measured single-pairing-check cost on the native C++ backend, scaled
+    to the reference's 4-core node.  Returns (sigs_per_sec, source) where
+    source records whether the number was measured or estimated."""
+    try:
+        from lighthouse_tpu.crypto.bls import cpp_backend
+    except ImportError:
+        return BLST_BASELINE_SIGS_PER_SEC, "estimate"
+    per_sec = cpp_backend.measure_pairing_throughput(n=64)
+    return float(per_sec) * 4.0, "measured-cpp-4core"
+
+
+def child_main():
+    import jax
+    platform = jax.default_backend()
+    mode = os.environ.get("LHTPU_BENCH", "tree_hash")
+    if mode == "bls":
         sigs_per_sec = bench_bls()
-        print(json.dumps({
+        baseline, baseline_source = _measured_host_baseline()
+        rec = {
             "metric": "bls_batch_verify_throughput",
             "value": round(sigs_per_sec, 1),
             "unit": "sigs/s/chip",
-            "vs_baseline": round(sigs_per_sec / BLST_BASELINE_SIGS_PER_SEC,
-                                 3),
-        }))
-        return
-    ms = bench_tree_hash()
+            "vs_baseline": round(sigs_per_sec / baseline, 3),
+            "platform": platform,
+            "baseline_sigs_per_sec": round(baseline, 1),
+            "baseline_source": baseline_source,
+            "n_sigs": N_SIGS,
+        }
+    else:
+        ms = bench_tree_hash()
+        rec = {
+            "metric": "beacon_state_tree_hash_1m_validators",
+            "value": round(ms, 2),
+            "unit": "ms",
+            "vs_baseline": round(TARGET_MS / ms, 3),
+            "platform": platform,
+        }
+    print(json.dumps(rec))
+
+
+# --------------------------------------------------------------------------
+# parent: orchestration (never imports jax)
+# --------------------------------------------------------------------------
+
+def _child_env(force_cpu):
+    env = dict(os.environ)
+    env["LHTPU_BENCH_CHILD"] = "1"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(_REPO, ".jax_cache"))
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if force_cpu:
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _try_child(force_cpu, timeout):
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], cwd=_REPO,
+            env=_child_env(force_cpu), capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, "timeout after %ds" % timeout
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec, None
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return None, "rc=%d stderr: %s" % (proc.returncode,
+                                       proc.stderr[-1500:])
+
+
+def main():
+    if os.environ.get("LHTPU_BENCH_CHILD"):
+        return child_main()
+    errors = []
+    # (force_cpu, timeout_s): one bounded try on the default (TPU)
+    # platform — cold-cache compiles are budgeted into the 900 s — then
+    # straight to the forced-CPU fallback (a wedged TPU tunnel hangs, it
+    # doesn't error, so retrying the same config only delays the JSON).
+    budget = [(False, int(os.environ.get("LHTPU_BENCH_TPU_TIMEOUT", 900))),
+              (True, int(os.environ.get("LHTPU_BENCH_CPU_TIMEOUT", 1200)))]
+    if os.environ.get("LHTPU_BENCH_FORCE_CPU"):
+        budget = [budget[-1]]
+    for force_cpu, timeout in budget:
+        rec, err = _try_child(force_cpu, timeout)
+        if rec is not None:
+            print(json.dumps(rec))
+            return
+        errors.append(("cpu" if force_cpu else "default") + ": " + err)
+    metric = ("bls_batch_verify_throughput"
+              if os.environ.get("LHTPU_BENCH") == "bls"
+              else "beacon_state_tree_hash_1m_validators")
     print(json.dumps({
-        "metric": "beacon_state_tree_hash_1m_validators",
-        "value": round(ms, 2),
-        "unit": "ms",
-        "vs_baseline": round(TARGET_MS / ms, 3),
+        "metric": metric,
+        "value": None, "unit": "error", "vs_baseline": 0.0,
+        "error": " | ".join(errors)[-1000:],
     }))
 
 

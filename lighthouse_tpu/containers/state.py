@@ -317,6 +317,96 @@ class ValidatorRegistry:
         return out
 
 
+class BalancesColumn:
+    """Device-resident packed-uint64 balances column with dirty-chunk
+    scatter — the List[uint64, VALIDATOR_REGISTRY_LIMIT] analog of the
+    registry's milhouse-style leaf cache (4 balances per 32-byte chunk).
+
+    Steady-state rehash after k point-mutations moves only ceil(k/4)
+    chunks host->device; the merkle sweep itself is all-device.
+    """
+
+    def __init__(self, values: np.ndarray):
+        self.values = np.ascontiguousarray(values, dtype=np.uint64)
+        self._device_leaves = None
+        self._dirty_chunks: set[int] | None = None  # None = full rebuild
+        self._root_cache: bytes | None = None
+
+    def __len__(self) -> int:
+        return self.values.shape[0]
+
+    def _chunk_words(self, chunks: np.ndarray | None = None) -> np.ndarray:
+        """u32[C, 8] big-endian words of the packed-u64 chunks."""
+        from ..ops import sha256 as k
+        n = len(self)
+        n_chunks = (n + 3) // 4
+        if chunks is None:
+            padded = np.zeros(n_chunks * 4, dtype=np.uint64)
+            padded[:n] = self.values
+        else:
+            padded = np.zeros((len(chunks), 4), dtype=np.uint64)
+            for j, c in enumerate(chunks):
+                vals = self.values[c * 4:c * 4 + 4]
+                padded[j, :len(vals)] = vals
+        return k.chunks_to_words(padded.astype("<u8").tobytes())
+
+    def set_many(self, rows: np.ndarray, values: np.ndarray) -> None:
+        self.values[rows] = values
+        self._root_cache = None
+        if self._dirty_chunks is not None:
+            self._dirty_chunks.update(int(r) // 4 for r in np.unique(rows))
+
+    def set(self, i: int, value: int) -> None:
+        self.values[i] = value
+        self._root_cache = None
+        if self._dirty_chunks is not None:
+            self._dirty_chunks.add(int(i) // 4)
+
+    def replace(self, values: np.ndarray) -> None:
+        """Wholesale column replacement (epoch-processing rewards sweep)."""
+        self.values = np.ascontiguousarray(values, dtype=np.uint64)
+        self._root_cache = None
+        self._dirty_chunks = None
+
+    def _refresh_device_leaves(self):
+        from ..ops import sha256 as k
+        import jax.numpy as jnp
+        n_chunks = (len(self) + 3) // 4
+        full = (self._device_leaves is None or self._dirty_chunks is None
+                or int(self._device_leaves.shape[0]) != n_chunks)
+        if full:
+            self._device_leaves = k.jnp_asarray(self._chunk_words())
+        elif self._dirty_chunks:
+            chunks = np.fromiter(self._dirty_chunks, dtype=np.int64)
+            # pad to a power of two (idempotent scatter) to bound the
+            # number of compiled scatter shapes
+            target = 1 << (len(chunks) - 1).bit_length()
+            if target != len(chunks):
+                chunks = np.concatenate(
+                    [chunks, np.full(target - len(chunks), chunks[0])])
+            words = self._chunk_words(chunks)
+            self._device_leaves = self._device_leaves.at[
+                jnp.asarray(chunks)].set(k.jnp_asarray(words))
+        self._dirty_chunks = set()
+        return self._device_leaves
+
+    def hash_tree_root(self, registry_limit: int) -> bytes:
+        if self._root_cache is not None:
+            return self._root_cache
+        from ..ops import sha256 as k
+        n = len(self)
+        limit_chunks = (registry_limit * 8 + 31) // 32
+        if n == 0:
+            depth = (limit_chunks - 1).bit_length()
+            root = mix_in_length(ZERO_HASHES[depth], 0)
+        else:
+            leaves = self._refresh_device_leaves()
+            root_words = k.merkleize_words(leaves, limit_chunks)
+            root = mix_in_length(k.words_to_chunks(np.asarray(root_words)), n)
+        self._root_cache = root
+        return root
+
+
 # ---------------------------------------------------------------------------
 # Field schema
 # ---------------------------------------------------------------------------

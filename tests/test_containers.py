@@ -183,3 +183,42 @@ def test_validator_registry_htr_cache():
     assert vr.hash_tree_root(2**40) == r1  # cached
     vr.set_field(0, "effective_balance", 31 * 10**9)
     assert vr.hash_tree_root(2**40) != r1  # dirty invalidation
+
+
+def test_balances_column_matches_host_root():
+    import numpy as np
+    from lighthouse_tpu.containers.state import BalancesColumn, _np_uint_root
+    rng = np.random.default_rng(5)
+    n = 1003  # not a multiple of 4: exercises last-chunk padding
+    vals = rng.integers(0, 2**63, size=n, dtype=np.uint64)
+    bc = BalancesColumn(vals.copy())
+    vrl = 2**40
+    limit_chunks = (vrl * 8 + 31) // 32
+    assert bc.hash_tree_root(vrl) == _np_uint_root(vals, limit_chunks,
+                                                   length=n)
+    # dirty-chunk scatter path: mutate a few rows incl. the ragged tail
+    rows = np.array([0, 1, 501, n - 1])
+    newv = np.array([7, 8, 9, 10], dtype=np.uint64)
+    bc.set_many(rows, newv)
+    vals[rows] = newv
+    assert bc.hash_tree_root(vrl) == _np_uint_root(vals, limit_chunks,
+                                                   length=n)
+    # single-set path + cache invalidation
+    bc.set(2, 12345)
+    vals[2] = 12345
+    assert bc.hash_tree_root(vrl) == _np_uint_root(vals, limit_chunks,
+                                                   length=n)
+    # wholesale replace (epoch sweep)
+    vals2 = rng.integers(0, 2**63, size=n, dtype=np.uint64)
+    bc.replace(vals2)
+    assert bc.hash_tree_root(vrl) == _np_uint_root(vals2, limit_chunks,
+                                                   length=n)
+
+
+def test_balances_column_empty():
+    import numpy as np
+    from lighthouse_tpu.containers.state import BalancesColumn, _np_uint_root
+    bc = BalancesColumn(np.zeros(0, np.uint64))
+    vrl = 2**40
+    assert bc.hash_tree_root(vrl) == _np_uint_root(
+        np.zeros(0, np.uint64), (vrl * 8 + 31) // 32, length=0)
