@@ -221,17 +221,30 @@ def _try_child(force_cpu, timeout):
             [sys.executable, os.path.abspath(__file__)], cwd=_REPO,
             env=_child_env(force_cpu), capture_output=True, text=True,
             timeout=timeout)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # the child may have printed its record and then wedged at
+        # interpreter teardown (axon backend release) — salvage it
+        out = e.stdout or b""
+        rec = _parse_record(out.decode() if isinstance(out, bytes) else out)
+        if rec is not None:
+            return rec, None
         return None, "timeout after %ds" % timeout
-    for line in reversed(proc.stdout.strip().splitlines()):
+    rec = _parse_record(proc.stdout)
+    if rec is not None:
+        return rec, None
+    return None, "rc=%d stderr: %s" % (proc.returncode,
+                                       proc.stderr[-1500:])
+
+
+def _parse_record(stdout: str):
+    for line in reversed((stdout or "").strip().splitlines()):
         try:
             rec = json.loads(line)
             if isinstance(rec, dict) and "metric" in rec:
-                return rec, None
+                return rec
         except (json.JSONDecodeError, ValueError):
             continue
-    return None, "rc=%d stderr: %s" % (proc.returncode,
-                                       proc.stderr[-1500:])
+    return None
 
 
 def main():

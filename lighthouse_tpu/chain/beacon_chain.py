@@ -80,6 +80,28 @@ class BeaconChain:
         self.genesis_block_root = latest_block_header_root(genesis_state)
         self.genesis_validators_root = genesis_state.genesis_validators_root
 
+        if genesis_block is None and genesis_state.slot == 0:
+            # Synthesize the slot-0 SignedBeaconBlock (empty body, zero
+            # signature) so the store can serve it over blocks_by_range —
+            # backfill completion requires actually receiving the genesis
+            # block, not trusting an empty response.  The state may have
+            # been upgraded past its genesis fork, so pick the fork whose
+            # empty body matches the header's body_root.
+            hdr_body_root = genesis_state.latest_block_header.body_root
+            for fork in ForkName:
+                if fork > genesis_state.fork_name:
+                    break
+                body = self.T.BeaconBlockBody[fork]()
+                if htr(body) != hdr_body_root:
+                    continue
+                msg = self.T.BeaconBlock[fork](
+                    slot=0, proposer_index=0, parent_root=b"\x00" * 32,
+                    state_root=genesis_state.hash_tree_root(), body=body)
+                genesis_block = self.T.SignedBeaconBlock[fork](
+                    message=msg, signature=b"\x00" * 96)
+                assert htr(msg) == self.genesis_block_root
+                break
+
         self._lock = threading.RLock()
         self.fork_choice = ForkChoice(spec, self.genesis_block_root,
                                       genesis_state)
@@ -96,6 +118,8 @@ class BeaconChain:
         self.observed_aggregates = ObservedAggregates()
         self.observed_sync_contributors = ObservedAttesters()
         self.observed_blob_sidecars = ObservedBlobSidecars()
+        self._verified_sidecar_headers: OrderedDict[bytes, bool] = \
+            OrderedDict()
         self.observed_operations = ObservedOperations()
         self.observed_slashable = ObservedSlashable()
 
@@ -235,6 +259,12 @@ class BeaconChain:
         if self.observed_blob_sidecars.has_been_observed(
                 hdr.slot, hdr.proposer_index, sidecar.index):
             return None
+        # The header's proposer signature must be valid BEFORE the sidecar
+        # can be observed or occupy availability-cache space — otherwise a
+        # forged sidecar with a valid KZG proof would both block the real
+        # proposer's sidecar (observed-cache poisoning) and evict pending
+        # blocks from the LRU (blob_verification.rs:542-586 order).
+        self._verify_sidecar_header(sidecar, block_root)
         ready = self.data_availability_checker.put_sidecar(block_root,
                                                            sidecar)
         if ready is None and not \
@@ -246,6 +276,45 @@ class BeaconChain:
         if ready is not None:
             return self.import_block(ready)
         return None
+
+    def _verify_sidecar_header(self, sidecar, block_root: bytes) -> None:
+        """Proposer-index + header-signature gossip checks for a blob
+        sidecar (blob_verification.rs verify_blob_sidecar_for_gossip).
+        Raises BlockError on an invalid header; caches per block root so
+        the up-to-6 sidecars of one block verify the header once."""
+        from .errors import (
+            FINALIZED_SLOT, FUTURE_SLOT, INCORRECT_PROPOSER,
+            INVALID_SIGNATURE,
+        )
+        if block_root in self._verified_sidecar_headers:
+            return
+        hdr = sidecar.signed_block_header.message
+        # slot sanity BEFORE any state advance: an attacker-chosen huge slot
+        # would otherwise drive process_slots for billions of iterations
+        if hdr.slot > self.slot():
+            raise BlockError(FUTURE_SLOT, f"sidecar slot {hdr.slot}")
+        finalized_slot = self.finalized_checkpoint()[0] * \
+            self.spec.preset.slots_per_epoch
+        if hdr.slot <= finalized_slot:
+            raise BlockError(FINALIZED_SLOT, f"sidecar slot {hdr.slot}")
+        if not self.fork_choice.contains_block(hdr.parent_root):
+            raise BlockError(PARENT_UNKNOWN, hdr.parent_root.hex())
+        state = self.state_for_block_production(hdr.parent_root, hdr.slot)
+        expected = get_beacon_proposer_index(state, hdr.slot)
+        if hdr.proposer_index != expected:
+            raise BlockError(
+                INCORRECT_PROPOSER,
+                f"sidecar got {hdr.proposer_index}, expected {expected}")
+        from ..state_transition.signature_sets import (
+            block_proposal_signature_set,
+        )
+        s = block_proposal_signature_set(
+            state, sidecar.signed_block_header, block_root)
+        if not bls.verify_signature_sets([s]):
+            raise BlockError(INVALID_SIGNATURE, "blob sidecar header")
+        self._verified_sidecar_headers[block_root] = True
+        while len(self._verified_sidecar_headers) > 64:
+            self._verified_sidecar_headers.popitem(last=False)
 
     def import_block(self, ep) -> bytes:
         """beacon_chain.rs:3449 import_block: fork choice + store + head."""

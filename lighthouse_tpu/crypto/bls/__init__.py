@@ -198,9 +198,13 @@ _current: BlsBackend | None = None
 
 
 def get_backend() -> BlsBackend:
+    """Fail-closed: an entry point that never called set_backend gets real
+    (python) crypto, never the always-valid fake backend — 'fake' must be
+    an explicit opt-in (--crypto-backend=fake / tests), mirroring the
+    reference's fake_crypto feature gate."""
     global _current
     if _current is None:
-        _current = _make("fake")
+        _current = _make("python")
     return _current
 
 

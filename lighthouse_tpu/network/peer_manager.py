@@ -18,7 +18,8 @@ class PeerInfo:
 class PeerManager:
     BAN_THRESHOLD = -20.0
     SCORES = {"reject": -5.0, "ignore": -0.5, "accept": 0.1,
-              "rate_limited": -1.0, "timeout": -2.0, "bad_segment": -10.0}
+              "rate_limited": -1.0, "timeout": -2.0, "bad_segment": -10.0,
+              "empty_batch": -3.0}
 
     def __init__(self, target_peers: int = 16):
         self.peers: dict[str, PeerInfo] = {}

@@ -92,8 +92,9 @@ class SyncManager:
         spe = chain.spec.preset.slots_per_epoch
         batch_slots = batch_slots or EPOCHS_PER_BATCH * spe
         stored = 0
+        window = batch_slots
         while anchor_slot > 0:
-            start = max(0, anchor_slot - batch_slots)
+            start = max(0, anchor_slot - window)
             try:
                 resp = self.rpc.request(
                     peer, "beacon_blocks_by_range",
@@ -113,10 +114,24 @@ class SyncManager:
                 chain.store.freezer_put_block_root(sb.message.slot, root)
                 expected_root = sb.message.parent_root
                 stored += 1
-            anchor_slot = (blocks[0].message.slot if blocks else start)
+            if not blocks:
+                # A run of skipped slots can legitimately empty a window,
+                # so widen and retry — the parent-root chain spans the gap.
+                # But never ADVANCE the anchor on a bare empty claim: an
+                # all-empty [0, anchor) (which must contain the genesis
+                # block) is provable misbehavior, penalize and rotate.
+                if start == 0:
+                    self.peers.report(peer_info.node_id, "empty_batch")
+                    break
+                window *= 2
+                continue
+            window = batch_slots
+            anchor_slot = blocks[0].message.slot
+            # complete only when the verified link chain itself reaches the
+            # slot-0 genesis block (served by peers since BeaconChain
+            # synthesizes + stores it)
             chain.store.set_backfill_anchor(anchor_slot, expected_root)
-            if start == 0:
-                chain.store.set_backfill_anchor(0, expected_root)
+            if anchor_slot == 0:
                 break
         return stored
 

@@ -109,3 +109,41 @@ def test_backfill_rejects_bad_linkage():
     finally:
         na.stop()
         nb.stop()
+
+
+def test_backfill_ignores_empty_batch_claims():
+    """ADVICE r1 (medium): a lazy/malicious peer answering by_range with
+    empty batches must not walk the backfill anchor down to 'complete'."""
+    spec = minimal_spec()
+    src = BeaconChainHarness(spec, 64)
+    src.extend_chain(2 * spec.preset.slots_per_epoch)
+    chain_a = src.chain
+    head = chain_a.head()
+    chain_b = (BeaconChainBuilder(spec)
+               .weak_subjectivity_anchor(head.head_state.copy(),
+                                         head.head_block)
+               .slot_clock(ManualSlotClock(0, spec.seconds_per_slot,
+                                           chain_a.slot()))
+               .build())
+    na = NetworkService(chain_a)
+    nb = NetworkService(chain_b)
+    # lazy provider: claims every range is empty
+    na.rpc.register("beacon_blocks_by_range", lambda peer, payload: [])
+    na.start()
+    nb.start()
+    try:
+        nb.dial("127.0.0.1", na.port)
+        assert _wait(lambda: nb.peers.best_peer_for_sync() is not None
+                     and nb.rpc.transport.peers)
+        anchor_before = chain_b.store.backfill_anchor()
+        stored = nb.sync.backfill()
+        assert stored == 0
+        anchor_after = chain_b.store.backfill_anchor()
+        assert anchor_after == anchor_before      # anchor did not move
+        assert anchor_after[0] > 0                # never marked complete
+        # the lazy peer was penalized
+        info = nb.peers.peers.get(list(nb.peers.peers)[0])
+        assert info.score < 0
+    finally:
+        na.stop()
+        nb.stop()

@@ -104,3 +104,57 @@ def test_blobs_before_block():
     # block arrives after its blobs -> imports immediately
     imported = chain.process_block(signed)
     assert imported == root
+
+
+def test_forged_sidecar_cannot_poison_observed_cache():
+    """ADVICE r1 (high): a sidecar with a bogus proposer_index must be
+    rejected BEFORE it is observed, so the real proposer's sidecar still
+    imports afterwards."""
+    h = _deneb_harness()
+    chain = h.chain
+    signed, blobs = _block_with_blobs(h, 1)
+    root = htr(signed.message)
+    sidecars = produce_sidecars(h.T, signed, blobs,
+                                chain.data_availability_checker.kzg)
+    real = sidecars[0]
+    hdr = real.signed_block_header.message
+    forged_hdr = h.T.SignedBeaconBlockHeader(
+        message=h.T.BeaconBlockHeader(
+            slot=hdr.slot, proposer_index=hdr.proposer_index + 1,
+            parent_root=hdr.parent_root, state_root=hdr.state_root,
+            body_root=hdr.body_root),
+        signature=real.signed_block_header.signature)
+    forged = h.T.BlobSidecar(
+        index=real.index, blob=real.blob, kzg_commitment=real.kzg_commitment,
+        kzg_proof=real.kzg_proof, signed_block_header=forged_hdr,
+        kzg_commitment_inclusion_proof=real.kzg_commitment_inclusion_proof)
+    with pytest.raises(BlockError):
+        chain.process_blob_sidecar(forged)
+    # the real proposer's sidecar is unaffected (not observed-blocked)
+    assert chain.process_blob_sidecar(real) is None  # pending, but accepted
+    assert chain.data_availability_checker.contains_sidecar(root, 0)
+
+
+def test_sidecar_unknown_parent_not_observed():
+    h = _deneb_harness()
+    chain = h.chain
+    signed, blobs = _block_with_blobs(h, 1)
+    sidecars = produce_sidecars(h.T, signed, blobs,
+                                chain.data_availability_checker.kzg)
+    real = sidecars[0]
+    hdr = real.signed_block_header.message
+    orphan_hdr = h.T.SignedBeaconBlockHeader(
+        message=h.T.BeaconBlockHeader(
+            slot=hdr.slot, proposer_index=hdr.proposer_index,
+            parent_root=b"\x77" * 32, state_root=hdr.state_root,
+            body_root=hdr.body_root),
+        signature=real.signed_block_header.signature)
+    orphan = h.T.BlobSidecar(
+        index=real.index, blob=real.blob, kzg_commitment=real.kzg_commitment,
+        kzg_proof=real.kzg_proof, signed_block_header=orphan_hdr,
+        kzg_commitment_inclusion_proof=real.kzg_commitment_inclusion_proof)
+    with pytest.raises(BlockError):
+        chain.process_blob_sidecar(orphan)
+    ohdr = orphan.signed_block_header.message
+    assert not chain.observed_blob_sidecars.has_been_observed(
+        ohdr.slot, ohdr.proposer_index, orphan.index)
