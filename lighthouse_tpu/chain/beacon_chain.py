@@ -105,6 +105,7 @@ class BeaconChain:
         self._lock = threading.RLock()
         self.fork_choice = ForkChoice(spec, self.genesis_block_root,
                                       genesis_state)
+        self.fork_choice.balances_provider = self._justified_balances
         self.canonical_head = CanonicalHead(
             self.genesis_block_root, genesis_block, genesis_state)
 
@@ -171,6 +172,22 @@ class BeaconChain:
             return self.canonical_head.head_state.copy()
 
     # -- state resolution ----------------------------------------------------
+
+    def _justified_balances(self, root: bytes) -> np.ndarray | None:
+        """Active effective balances of the justified-checkpoint state
+        (beacon_fork_choice_store.rs JustifiedBalances) — the block state
+        advanced to the checkpoint epoch start when slots were skipped."""
+        from ..fork_choice.fork_choice import _active_effective_balances
+        st = self._state_for(root)
+        if st is None:
+            return None
+        target_slot = compute_start_slot_at_epoch(
+            self.fork_choice.justified_checkpoint[0],
+            self.spec.preset.slots_per_epoch)
+        if st.slot < target_slot:
+            st = st.copy()
+            process_slots(st, target_slot)
+        return _active_effective_balances(st)
 
     def _state_for(self, block_root: bytes) -> BeaconState | None:
         st = self._snapshots.get(block_root)

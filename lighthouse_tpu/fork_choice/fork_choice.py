@@ -87,6 +87,16 @@ def _ckpt(checkpoint) -> tuple[int, bytes]:
     return (checkpoint.epoch, checkpoint.root)
 
 
+def _active_effective_balances(state: BeaconState) -> np.ndarray:
+    """Effective balance for validators active at the state's epoch, 0 for
+    the rest (the reference's JustifiedBalances::from_justified_state)."""
+    epoch = state.current_epoch()
+    v = state.validators
+    active = ((v.activation_epoch <= epoch) & (epoch < v.exit_epoch)
+              & ~v.slashed)
+    return np.where(active, v.effective_balance, 0).astype(np.uint64)
+
+
 class ForkChoice:
     """One instance per beacon chain; all methods assume external locking
     (the chain layer provides the canonical-head write lock)."""
@@ -113,9 +123,16 @@ class ForkChoice:
         self.current_slot = anchor_state.slot
         self.genesis_block_root = genesis_block_root
         # balances snapshot used for the previous delta application
-        # (the reference tracks justified-state balances; we track the
-        # latest-block state balances — TODO(round2): justified balances)
         self._old_balances = np.zeros(0, dtype=np.uint64)
+        # LMD weights come from the JUSTIFIED-checkpoint state's active
+        # effective balances (fork_choice.rs:642 / JustifiedBalances), not
+        # the latest block's.  The chain layer installs a provider
+        # (justified root -> balances); `self.balances` (latest block) is
+        # only the fallback when the justified state is unavailable.
+        self.balances_provider = None
+        self._justified_balances: np.ndarray | None = \
+            _active_effective_balances(anchor_state)
+        self._justified_balances_root: bytes = genesis_block_root
 
         anchor_root = genesis_block_root
         epoch = anchor_state.current_epoch()
@@ -283,24 +300,41 @@ class ForkChoice:
 
     # -- head ----------------------------------------------------------------
 
+    def _current_justified_balances(self) -> np.ndarray:
+        """Active effective balances of the justified-checkpoint state,
+        refreshed through the chain-installed provider when the justified
+        checkpoint moves; falls back to latest-block balances."""
+        root = self.justified_checkpoint[1]
+        if root != self._justified_balances_root and \
+                self.balances_provider is not None:
+            bal = self.balances_provider(root)
+            if bal is not None:
+                self._justified_balances = np.asarray(bal, dtype=np.uint64)
+                self._justified_balances_root = root
+        if self._justified_balances is not None and \
+                self._justified_balances_root == root:
+            return self._justified_balances
+        return self.balances
+
     def get_head(self, current_slot: int) -> bytes:
         """Recompute and return the head root (fork_choice.rs:468)."""
         self.update_time(current_slot)
-        new_balances = self.balances
+        new_balances = self._current_justified_balances()
         deltas = compute_deltas(self.proto_array.indices, self.votes,
                                 self._old_balances, new_balances,
                                 self.equivocating_indices)
-        boost = (self.proposer_boost_root, self._proposer_boost_amount())
+        boost = (self.proposer_boost_root,
+                 self._proposer_boost_amount(new_balances))
         self.proto_array.apply_score_changes(
             deltas, self.justified_checkpoint, self.finalized_checkpoint,
             boost)
         self._old_balances = new_balances.copy()
         return self.proto_array.find_head(self.justified_checkpoint[1])
 
-    def _proposer_boost_amount(self) -> int:
+    def _proposer_boost_amount(self, balances: np.ndarray) -> int:
         if self.proposer_boost_root == b"\x00" * 32:
             return 0
-        total = int(self.balances.sum())
+        total = int(balances.sum())
         committee_weight = total // self.spec.preset.slots_per_epoch
         return committee_weight * self.spec.proposer_score_boost // 100
 

@@ -17,6 +17,10 @@ EPOCHS_PER_BATCH = 2
 
 
 class SyncManager:
+    # consecutive empty by_range windows tolerated per backfill call
+    # before the peer is penalized and rotated
+    MAX_EMPTY_WINDOWS = 64
+
     def __init__(self, chain, rpc, peer_manager):
         self.chain = chain
         self.rpc = rpc
@@ -91,20 +95,25 @@ class SyncManager:
             return 0
         spe = chain.spec.preset.slots_per_epoch
         batch_slots = batch_slots or EPOCHS_PER_BATCH * spe
+        max_req = chain.spec.max_request_blocks
         stored = 0
-        window = batch_slots
+        window = min(batch_slots, max_req)
+        req_end = anchor_slot  # exclusive top of the next request window
+        empty_windows = 0
         while anchor_slot > 0:
-            start = max(0, anchor_slot - window)
+            start = max(0, req_end - window)
             try:
                 resp = self.rpc.request(
                     peer, "beacon_blocks_by_range",
-                    {"start_slot": start, "count": anchor_slot - start})
+                    {"start_slot": start, "count": req_end - start})
             except (TimeoutError, RuntimeError):
                 self.peers.report(peer_info.node_id, "timeout")
                 break
             blocks = [b for b in (self._decode_block(x) for x in resp or [])
                       if b is not None]
-            # verify the batch links into the trusted root, newest first
+            # verify the batch links into the trusted root, newest first.
+            # Because every higher window came back empty, the newest block
+            # of this one must be the direct parent of the link chain.
             for sb in reversed(blocks):
                 root = htr(sb.message)
                 if root != expected_root:
@@ -115,18 +124,23 @@ class SyncManager:
                 expected_root = sb.message.parent_root
                 stored += 1
             if not blocks:
-                # A run of skipped slots can legitimately empty a window,
-                # so widen and retry — the parent-root chain spans the gap.
-                # But never ADVANCE the anchor on a bare empty claim: an
-                # all-empty [0, anchor) (which must contain the genesis
-                # block) is provable misbehavior, penalize and rotate.
-                if start == 0:
+                # A run of skipped slots can legitimately empty a window:
+                # slide the window down (growing it up to the rate-limit
+                # cap) and retry.  Never ADVANCE the anchor on a bare
+                # empty claim — an all-empty [0, anchor) (which must
+                # contain the genesis block) or an endless run of empty
+                # claims is misbehavior: penalize and rotate.
+                empty_windows += 1
+                if start == 0 or empty_windows > self.MAX_EMPTY_WINDOWS:
                     self.peers.report(peer_info.node_id, "empty_batch")
                     break
-                window *= 2
+                req_end = start
+                window = min(window * 2, max_req)
                 continue
-            window = batch_slots
+            empty_windows = 0
+            window = min(batch_slots, max_req)
             anchor_slot = blocks[0].message.slot
+            req_end = anchor_slot
             # complete only when the verified link chain itself reaches the
             # slot-0 genesis block (served by peers since BeaconChain
             # synthesizes + stores it)

@@ -149,3 +149,61 @@ def test_fork_choice_end_to_end_with_chain():
     # justification propagated into fork choice
     assert fc.justified_checkpoint[0] >= 1
     assert fc.finalized_checkpoint[0] >= 1
+
+
+def test_get_head_uses_justified_balances():
+    """VERDICT r1 item 4: LMD weights must come from the justified-
+    checkpoint state's active effective balances, not the latest block's
+    (fork_choice.rs:642 / JustifiedBalances)."""
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = StateHarness(spec, 4)
+    state = h.state
+    # validator 1 exited before the justified checkpoint: weight 0 there
+    state.validators.set_field(1, "exit_epoch", 0)
+    fc = ForkChoice(spec, _root(0), state)
+    fc.proto_array.on_block(_node(1, _root(1), 0))
+    fc.proto_array.on_block(_node(1, _root(2), 0))
+    # a later block's state claims validator 1 is the whale — the buggy
+    # behavior weighted votes with THESE balances
+    fc.balances = np.array(
+        [32 * 10**9, 64 * 10**9, 0, 0], dtype=np.uint64)
+    fc._apply_vote([0], _root(1), 0)
+    fc._apply_vote([1], _root(2), 0)
+    # justified balances: val0=32eth, val1=0 -> root(1) wins
+    assert fc.get_head(1) == _root(1)
+    # sanity: disabling the justified snapshot reproduces the old
+    # (wrong) latest-block weighting, flipping the head
+    fc2 = ForkChoice(spec, _root(0), state)
+    fc2.proto_array.on_block(_node(1, _root(1), 0))
+    fc2.proto_array.on_block(_node(1, _root(2), 0))
+    fc2.balances = fc.balances
+    fc2._justified_balances = None
+    fc2._apply_vote([0], _root(1), 0)
+    fc2._apply_vote([1], _root(2), 0)
+    assert fc2.get_head(1) == _root(2)
+
+
+def test_justified_balances_provider_refresh():
+    """When the justified checkpoint moves, the chain-installed provider
+    is consulted for the new checkpoint state's balances."""
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = StateHarness(spec, 4)
+    fc = ForkChoice(spec, _root(0), h.state)
+    fc.proto_array.on_block(_node(1, _root(1), 0))
+    fc.proto_array.on_block(_node(1, _root(2), 0))
+    asked = []
+
+    def provider(root):
+        asked.append(root)
+        return np.array([0, 48 * 10**9, 0, 0], dtype=np.uint64)
+
+    fc.balances_provider = provider
+    # simulate justification advancing to root(1)'s checkpoint; keep the
+    # node viability anchored at epoch 0 by reusing the same root
+    fc._justified_balances_root = b"\xff" * 32  # stale -> must refresh
+    fc._apply_vote([0], _root(1), 0)
+    fc._apply_vote([1], _root(2), 0)
+    assert fc.get_head(1) == _root(2)  # provider says val1 is the whale
+    assert asked == [fc.justified_checkpoint[1]]
