@@ -173,17 +173,18 @@ class BeaconChain:
 
     # -- state resolution ----------------------------------------------------
 
-    def _justified_balances(self, root: bytes) -> np.ndarray | None:
+    def _justified_balances(self, checkpoint: tuple[int, bytes]
+                            ) -> np.ndarray | None:
         """Active effective balances of the justified-checkpoint state
         (beacon_fork_choice_store.rs JustifiedBalances) — the block state
         advanced to the checkpoint epoch start when slots were skipped."""
         from ..fork_choice.fork_choice import _active_effective_balances
+        epoch, root = checkpoint
         st = self._state_for(root)
         if st is None:
             return None
         target_slot = compute_start_slot_at_epoch(
-            self.fork_choice.justified_checkpoint[0],
-            self.spec.preset.slots_per_epoch)
+            epoch, self.spec.preset.slots_per_epoch)
         if st.slot < target_slot:
             st = st.copy()
             process_slots(st, target_slot)

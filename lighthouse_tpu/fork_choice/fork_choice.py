@@ -132,7 +132,10 @@ class ForkChoice:
         self.balances_provider = None
         self._justified_balances: np.ndarray | None = \
             _active_effective_balances(anchor_state)
-        self._justified_balances_root: bytes = genesis_block_root
+        # keyed by the full (epoch, root) checkpoint: the same root can be
+        # re-justified at a later epoch across empty boundary slots, and
+        # activations/exits at that epoch change the weights
+        self._justified_balances_ckpt: tuple[int, bytes] = justified
 
         anchor_root = genesis_block_root
         epoch = anchor_state.current_epoch()
@@ -304,15 +307,15 @@ class ForkChoice:
         """Active effective balances of the justified-checkpoint state,
         refreshed through the chain-installed provider when the justified
         checkpoint moves; falls back to latest-block balances."""
-        root = self.justified_checkpoint[1]
-        if root != self._justified_balances_root and \
+        ckpt = self.justified_checkpoint
+        if ckpt != self._justified_balances_ckpt and \
                 self.balances_provider is not None:
-            bal = self.balances_provider(root)
+            bal = self.balances_provider(ckpt)
             if bal is not None:
                 self._justified_balances = np.asarray(bal, dtype=np.uint64)
-                self._justified_balances_root = root
+                self._justified_balances_ckpt = ckpt
         if self._justified_balances is not None and \
-                self._justified_balances_root == root:
+                self._justified_balances_ckpt == ckpt:
             return self._justified_balances
         return self.balances
 

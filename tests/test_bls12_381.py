@@ -142,3 +142,56 @@ def test_cofactors_sane():
     # derived cofactors reproduce the known h1; h2 checked by divisibility
     assert H_EFF_G1 == 0x396C8C005555E1568C00AAAB0000AAAB
     assert (P * P + 1) % 1 == 0  # placeholder arithmetic sanity
+
+
+def test_sswu_iso_constants_match_rfc9380_e3():
+    """The Vélu-derived 3-isogeny constants must reproduce RFC 9380
+    appendix E.3 bit-exactly — this is what makes signatures byte-
+    compatible with blst (ref: crypto/bls/src/impls/blst.rs:15)."""
+    from lighthouse_tpu.crypto.bls12_381.hash_to_curve import (
+        ISO_X_DEN, ISO_X_NUM, ISO_Y_DEN, ISO_Y_NUM,
+    )
+    from lighthouse_tpu.crypto.bls12_381.fields import Fp2, P
+    c = 0x5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6
+    assert ISO_X_NUM[0] == Fp2(c, c)
+    assert ISO_X_NUM[1] == Fp2(0, 0x11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71a)
+    assert ISO_X_NUM[2] == Fp2(
+        0x11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71e,
+        0x8ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38d)
+    assert ISO_X_NUM[3] == Fp2(
+        0x171d6541fa38ccfaed6dea691f5fb614cb14b4e7f4e810aa22d6108f142b85757098e38d0f671c7188e2aaaaaaaa5ed1, 0)
+    assert ISO_X_DEN[0] == Fp2(0, P - 72)
+    assert ISO_X_DEN[1] == Fp2(12, P - 12)
+    assert ISO_Y_NUM[3] == Fp2(
+        0x124c9ad43b6cf79bfbf7043de3811ad0761b0f37a1e26286b0e977c69aa274524e79097a56dc4bd9e1b371c71c718b10, 0)
+    assert ISO_Y_DEN[0] == Fp2(P - 432, P - 432)
+    assert ISO_Y_DEN[1] == Fp2(0, P - 216)
+    assert ISO_Y_DEN[2] == Fp2(18, P - 18)
+
+
+def test_sswu_map_properties():
+    """SSWU lands on E', the isogeny lands on E and is a homomorphism."""
+    from lighthouse_tpu.crypto.bls12_381.curve import B_G2, G2Point
+    from lighthouse_tpu.crypto.bls12_381.fields import Fp2, P
+    from lighthouse_tpu.crypto.bls12_381.hash_to_curve import (
+        ISO_A, ISO_B, iso_map_g2, map_to_curve_sswu_prime,
+    )
+    import random
+    rng = random.Random(11)
+    pts = []
+    for _ in range(4):
+        u = Fp2(rng.randrange(P), rng.randrange(P))
+        xp, yp = map_to_curve_sswu_prime(u)
+        assert yp.square() == xp * xp * xp + ISO_A * xp + ISO_B
+        x, y = iso_map_g2(xp, yp)
+        assert y.square() == x * x * x + B_G2
+        pts.append((xp, yp))
+
+    (x1, y1), (x2, y2) = pts[0], pts[1]
+    lam = (y2 - y1) * (x2 - x1).inv()
+    x3 = lam.square() - x1 - x2
+    s = (x3, lam * (x1 - x3) - y1)
+    lhs = iso_map_g2(*s)
+    rhs = G2Point(*iso_map_g2(x1, y1)).add(
+        G2Point(*iso_map_g2(x2, y2))).to_affine()
+    assert lhs == (rhs[0], rhs[1])

@@ -195,15 +195,17 @@ def test_justified_balances_provider_refresh():
     fc.proto_array.on_block(_node(1, _root(2), 0))
     asked = []
 
-    def provider(root):
-        asked.append(root)
+    def provider(ckpt):
+        asked.append(ckpt)
         return np.array([0, 48 * 10**9, 0, 0], dtype=np.uint64)
 
     fc.balances_provider = provider
     # simulate justification advancing to root(1)'s checkpoint; keep the
-    # node viability anchored at epoch 0 by reusing the same root
-    fc._justified_balances_root = b"\xff" * 32  # stale -> must refresh
+    # node viability anchored at epoch 0 by reusing the same root.  The
+    # cache is keyed on the FULL (epoch, root) checkpoint — the same root
+    # re-justified at a later epoch must refresh
+    fc._justified_balances_ckpt = (99, fc.justified_checkpoint[1])
     fc._apply_vote([0], _root(1), 0)
     fc._apply_vote([1], _root(2), 0)
     assert fc.get_head(1) == _root(2)  # provider says val1 is the whale
-    assert asked == [fc.justified_checkpoint[1]]
+    assert asked == [fc.justified_checkpoint]
