@@ -177,6 +177,17 @@ def _derive_cofactors():
 
 H_EFF_G1, H_EFF_G2 = _derive_cofactors()
 
+# RFC 9380 §8.8.2 effective cofactor for the G2 suite.  NOT the exact
+# cofactor h2 (= H_EFF_G2): the suite's h_eff is the scalar effected by the
+# Budroni-Pintore psi-based fast clearing, s = 4u^2 - 2u - 1 on the G2
+# eigencomponent.  Derived, not hardcoded: the unique multiple of h2 that is
+# congruent to s mod r with the smallest quotient < r.  Using h2 itself
+# would land on [c]P for c = h2*s^-1 != 1 — a valid but non-interoperable
+# point (signatures would differ from blst byte-for-byte).
+_S_BP = 4 * X_PARAM * X_PARAM - 2 * X_PARAM - 1
+H_EFF_G2_RFC = H_EFF_G2 * ((_S_BP * pow(H_EFF_G2, -1, R)) % R)
+assert H_EFF_G2_RFC % H_EFF_G2 == 0 and H_EFF_G2_RFC % R == _S_BP % R
+
 assert G1_GENERATOR.is_on_curve()
 assert G2_GENERATOR.is_on_curve()
 

@@ -13,15 +13,14 @@ ciphersuite (RFC 9380 §8.8.2), matching the reference's blst DST + map
   RFC 9380 appendix E.3 constants bit-exactly (pinned in
   tests/test_bls12_381.py), so outputs are byte-compatible with blst.
 
-The previous SVDW map (round 1's documented deviation) is kept as
-``map_to_curve_svdw`` for the kernel-equivalence tests only.
+Round 1's SVDW deviation is gone; every hash path is the spec ciphersuite.
 """
 from __future__ import annotations
 
 import hashlib
 import struct
 
-from .curve import H_EFF_G2, Point, G2Point, B_G2
+from .curve import H_EFF_G2_RFC, Point, G2Point, B_G2
 from .fields import Fp, Fp2, P
 
 DST_POP = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
@@ -63,70 +62,6 @@ def hash_to_field_fp2(msg: bytes, count: int, dst: bytes) -> list[Fp2]:
             coeffs.append(Fp(int.from_bytes(uniform[off:off + _L], "big")))
         out.append(Fp2(coeffs[0], coeffs[1]))
     return out
-
-
-# -- SVDW constant derivation (RFC 9380 appendix H.1 / §6.6.1) ---------------
-
-def _g(x: Fp2) -> Fp2:
-    return x * x * x + B_G2
-
-
-def _find_z_svdw() -> Fp2:
-    # candidate order: F(ctr), F(-ctr), F(ctr*u), F(-ctr*u), ...
-    ctr = 1
-    while True:
-        for z in (Fp2(ctr, 0), Fp2(-ctr % P, 0), Fp2(0, ctr),
-                  Fp2(0, -ctr % P)):
-            gz = _g(z)
-            if gz.is_zero():
-                continue
-            h = -(z.square() * 3) * (gz * 4).inv()  # A = 0
-            if h.is_zero():
-                continue
-            if not h.is_square():
-                continue
-            if gz.is_square() or _g(-z * Fp2(pow(2, P - 2, P), 0)).is_square():
-                return z
-        ctr += 1
-
-
-_Z = _find_z_svdw()
-_C1 = _g(_Z)                                  # g(Z)
-_C2 = -_Z * Fp2(pow(2, P - 2, P), 0)          # -Z / 2
-_tmp = -(_C1 * (_Z.square() * 3))             # -g(Z) * (3Z^2 + 4A), A = 0
-_C3 = _tmp.sqrt()
-assert _C3 is not None
-if _C3.sgn0() == 1:
-    _C3 = -_C3
-_C4 = -(_C1 * 4) * (_Z.square() * 3).inv()    # -4 g(Z) / (3Z^2 + 4A)
-
-
-def map_to_curve_svdw(u: Fp2) -> tuple[Fp2, Fp2]:
-    tv1 = u.square() * _C1
-    tv2 = Fp2(1, 0) + tv1
-    tv1 = Fp2(1, 0) - tv1
-    tv3 = tv1 * tv2
-    tv3 = tv3.inv() if not tv3.is_zero() else Fp2(0, 0)
-    tv4 = u * tv1 * tv3 * _C3
-    x1 = _C2 - tv4
-    gx1 = _g(x1)
-    e1 = gx1.is_square()
-    x2 = _C2 + tv4
-    gx2 = _g(x2)
-    e2 = gx2.is_square() and not e1
-    x3 = tv2.square() * tv3
-    x3 = x3.square() * _C4 + _Z
-    x = x3
-    if e1:
-        x = x1
-    elif e2:
-        x = x2
-    gx = _g(x)
-    y = gx.sqrt()
-    assert y is not None, "map_to_curve: g(x) must be square"
-    if u.sgn0() != y.sgn0():
-        y = -y
-    return x, y
 
 
 # -- simplified SWU on E' + 3-isogeny to E (RFC 9380 §6.6.2, §8.8.2) ---------
@@ -217,7 +152,7 @@ def map_to_curve_sswu(u: Fp2) -> Point:
 
 
 def clear_cofactor_g2(p: Point) -> Point:
-    return p.mul(H_EFF_G2)
+    return p.mul(H_EFF_G2_RFC)
 
 
 def hash_to_g2(msg: bytes, dst: bytes = DST_POP) -> Point:
