@@ -133,3 +133,56 @@ def test_pairing_check_verifies_signature():
     h2 = hash_to_g2(b"\x5b" * 32)
     qx2, qy2 = _encode_g2([sig, h2])
     assert not bool(np.asarray(k.pairing_check_batch(px, py, qx2, qy2)))
+
+
+def test_device_g2_decompress_and_subgroup():
+    """Batched device decompression + psi subgroup check vs the oracle."""
+    import numpy as np
+    from lighthouse_tpu.crypto.bls12_381 import g2_compress
+    from lighthouse_tpu.crypto.bls12_381 import sig as osig
+    from lighthouse_tpu.crypto.bls12_381.curve import B_G2, G2Point, R
+    from lighthouse_tpu.crypto.bls12_381.fields import Fp2
+    pts = [osig.sign(100 + i, bytes([i]) * 32) for i in range(3)]
+    xs, flags = [], []
+    for p in pts:
+        cb = g2_compress(p)
+        xs += [int.from_bytes(cb[48:96], "big"),
+               int.from_bytes(bytes([cb[0] & 0x1f]) + cb[1:48], "big")]
+        flags.append(bool(cb[0] & 0x20))
+    x = k.fp_encode(xs).reshape(3, 2, 32)
+    y, ok = k.g2_decompress_batch(x, np.array(flags))
+    assert bool(np.asarray(ok).all())
+    yl = k.fp_decode(np.asarray(y))
+    for i, p in enumerate(pts):
+        _, Y = p.to_affine()
+        assert (yl[2 * i], yl[2 * i + 1]) == (int(Y.c0), int(Y.c1))
+    one2 = np.broadcast_to(k.FP2_ONE, (3, 2, 32))
+    assert bool(np.asarray(
+        k.g2_in_subgroup_batch(x, y, one2)).all())
+    # an on-curve point OUTSIDE the subgroup must be rejected
+    xx = 1
+    while True:
+        rhs = Fp2(xx, 0) * Fp2(xx, 0) * Fp2(xx, 0) + B_G2
+        yy = rhs.sqrt()
+        if yy is not None:
+            break
+        xx += 1
+    assert not G2Point(Fp2(xx, 0), yy).mul(R).is_infinity()
+    bx, by = k.fp2_encode([Fp2(xx, 0)]), k.fp2_encode([yy])
+    bo = np.broadcast_to(k.FP2_ONE, (1, 2, 32))
+    assert not bool(np.asarray(
+        k.g2_in_subgroup_batch(bx, by, bo)).any())
+
+
+def test_device_hash_to_g2_matches_oracle():
+    """SSWU + isogeny + B-P cofactor on device == oracle hash_to_g2."""
+    import numpy as np
+    from lighthouse_tpu.crypto.bls12_381.hash_to_curve import DST_POP
+    msgs = [b"", b"abc", b"\x00" * 32]
+    x, y, z = k.hash_to_g2_batch(msgs, DST_POP)
+    ax, ay = k.jacobian_to_affine_fp2(x, y, z)
+    axl, ayl = k.fp_decode(np.asarray(ax)), k.fp_decode(np.asarray(ay))
+    for i, m in enumerate(msgs):
+        X, Y = hash_to_g2(m).to_affine()
+        assert (axl[2 * i], axl[2 * i + 1], ayl[2 * i], ayl[2 * i + 1]) == \
+            (int(X.c0), int(X.c1), int(Y.c0), int(Y.c1))
