@@ -1,0 +1,193 @@
+"""Noise-like authenticated encryption for the p2p transport.
+
+An XX-pattern-inspired handshake over X25519 + HKDF-SHA256 +
+ChaCha20-Poly1305 (all from the `cryptography` package).  Not
+wire-compatible with libp2p-noise (that would require the exact Noise
+state machine + protobuf payloads); it provides the same properties the
+reference gets from it (ref: lighthouse_network/src/service/utils.rs
+build_transport — noise XX + yamux):
+
+- ephemeral-ephemeral secrecy (forward secrecy per connection),
+- mutual STATIC-key authentication: the responder proves possession of
+  its static key by completing message 4 (final keys depend on es), the
+  initiator by message 5 (final keys depend on se),
+- peer ids DERIVED from the authenticated static key (sha256(pub)[:8]),
+  so a peer cannot claim another's id,
+- every transport frame AEAD-sealed with per-direction nonce counters
+  and the handshake transcript hash bound as associated data.
+
+Handshake (h = rolling sha256 transcript):
+  m1  I->R: e_i
+  m2  R->I: e_r || Enc(k_ee;     s_r_pub, ad=h)
+  m3  I->R:        Enc(k_ee_es;  s_i_pub, ad=h)
+  final: k_i2r, k_r2i = HKDF(ee || es || se, info=h)
+  m4  R->I: Enc(k_r2i; "fin", ad=h)     (authenticates R)
+  m5  I->R: Enc(k_i2r; "fin", ad=h)     (authenticates I)
+"""
+from __future__ import annotations
+
+import hashlib
+import struct
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey, X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives import hashes
+
+
+class HandshakeError(Exception):
+    pass
+
+
+def _hkdf(key_material: bytes, info: bytes, length: int = 32) -> bytes:
+    return HKDF(algorithm=hashes.SHA256(), length=length, salt=b"",
+                info=info).derive(key_material)
+
+
+def _pub_bytes(priv: X25519PrivateKey) -> bytes:
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat,
+    )
+    return priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+
+
+def _raw_pub(data: bytes) -> X25519PublicKey:
+    return X25519PublicKey.from_public_bytes(data)
+
+
+class NodeIdentity:
+    """Stable static keypair; node_id is derived from (and authenticated
+    by) the public key."""
+
+    def __init__(self, static_priv: bytes | None = None):
+        self.key = (X25519PrivateKey.from_private_bytes(static_priv)
+                    if static_priv else X25519PrivateKey.generate())
+        self.pub = _pub_bytes(self.key)
+        self.node_id = node_id_of(self.pub)
+
+
+def node_id_of(static_pub: bytes) -> str:
+    return hashlib.sha256(static_pub).digest()[:8].hex()
+
+
+class SecureChannel:
+    """Post-handshake AEAD framing: seal/open with counter nonces."""
+
+    def __init__(self, k_send: bytes, k_recv: bytes, transcript: bytes):
+        self._send = ChaCha20Poly1305(k_send)
+        self._recv = ChaCha20Poly1305(k_recv)
+        self._ad = transcript
+        self._ns = 0
+        self._nr = 0
+
+    @staticmethod
+    def _nonce(n: int) -> bytes:
+        return b"\x00\x00\x00\x00" + struct.pack("<Q", n)
+
+    def seal(self, plaintext: bytes) -> bytes:
+        n = self._ns
+        self._ns += 1
+        return self._send.encrypt(self._nonce(n), plaintext, self._ad)
+
+    def open(self, ciphertext: bytes) -> bytes:
+        n = self._nr
+        self._nr += 1
+        try:
+            return self._recv.decrypt(self._nonce(n), ciphertext, self._ad)
+        except Exception as e:
+            raise HandshakeError(f"AEAD open failed: {e}") from None
+
+
+def _mix(h: bytes, data: bytes) -> bytes:
+    return hashlib.sha256(h + data).digest()
+
+
+_PROTO = b"lighthouse-tpu-noise-v1"
+
+
+def initiator_handshake(sock_send, sock_recv, identity: NodeIdentity
+                        ) -> tuple[SecureChannel, bytes]:
+    """Returns (channel, remote_static_pub).  sock_send(bytes)/
+    sock_recv(n)->bytes are blocking exact-IO callables."""
+    e = X25519PrivateKey.generate()
+    h = hashlib.sha256(_PROTO).digest()
+    m1 = _pub_bytes(e)
+    sock_send(m1)
+    h = _mix(h, m1)
+
+    m2 = sock_recv(32 + 48)
+    e_r_pub, enc_sr = m2[:32], m2[32:]
+    ee = e.exchange(_raw_pub(e_r_pub))
+    k_ee = _hkdf(ee, b"k_ee" + h)
+    try:
+        s_r_pub = ChaCha20Poly1305(k_ee).decrypt(b"\x00" * 12, enc_sr, h)
+    except Exception:
+        raise HandshakeError("responder static decrypt failed") from None
+    h = _mix(h, m2)
+
+    es = e.exchange(_raw_pub(s_r_pub))
+    k3 = _hkdf(ee + es, b"k_ee_es" + h)
+    m3 = ChaCha20Poly1305(k3).encrypt(b"\x00" * 12, identity.pub, h)
+    sock_send(m3)
+    h = _mix(h, m3)
+
+    se = identity.key.exchange(_raw_pub(e_r_pub))
+    k_i2r = _hkdf(ee + es + se, b"i2r" + h)
+    k_r2i = _hkdf(ee + es + se, b"r2i" + h)
+    ch = SecureChannel(k_i2r, k_r2i, h)
+
+    fin_r = sock_recv(3 + 16)
+    try:
+        if ChaCha20Poly1305(k_r2i).decrypt(b"\xff" * 12, fin_r, h) != b"fin":
+            raise HandshakeError("bad responder fin")
+    except HandshakeError:
+        raise
+    except Exception:
+        raise HandshakeError("responder fin failed") from None
+    fin_i = ChaCha20Poly1305(k_i2r).encrypt(b"\xff" * 12, b"fin", h)
+    sock_send(fin_i)
+    return ch, s_r_pub
+
+
+def responder_handshake(sock_send, sock_recv, identity: NodeIdentity
+                        ) -> tuple[SecureChannel, bytes]:
+    e = X25519PrivateKey.generate()
+    h = hashlib.sha256(_PROTO).digest()
+    m1 = sock_recv(32)
+    h = _mix(h, m1)
+    ee = e.exchange(_raw_pub(m1))
+    e_r_pub = _pub_bytes(e)
+    # the initiator derives k_ee with the transcript BEFORE m2 is mixed
+    k_ee = _hkdf(ee, b"k_ee" + h)
+    enc_sr = ChaCha20Poly1305(k_ee).encrypt(b"\x00" * 12, identity.pub, h)
+    m2 = e_r_pub + enc_sr
+    sock_send(m2)
+    h = _mix(h, m2)
+
+    m3 = sock_recv(32 + 16)
+    es = identity.key.exchange(_raw_pub(m1))
+    k3 = _hkdf(ee + es, b"k_ee_es" + h)
+    try:
+        s_i_pub = ChaCha20Poly1305(k3).decrypt(b"\x00" * 12, m3, h)
+    except Exception:
+        raise HandshakeError("initiator static decrypt failed") from None
+    h = _mix(h, m3)
+
+    se = e.exchange(_raw_pub(s_i_pub))
+    k_i2r = _hkdf(ee + es + se, b"i2r" + h)
+    k_r2i = _hkdf(ee + es + se, b"r2i" + h)
+    ch = SecureChannel(k_r2i, k_i2r, h)   # responder sends on r2i
+
+    fin_r = ChaCha20Poly1305(k_r2i).encrypt(b"\xff" * 12, b"fin", h)
+    sock_send(fin_r)
+    fin_i = sock_recv(3 + 16)
+    try:
+        if ChaCha20Poly1305(k_i2r).decrypt(b"\xff" * 12, fin_i, h) != b"fin":
+            raise HandshakeError("bad initiator fin")
+    except HandshakeError:
+        raise
+    except Exception:
+        raise HandshakeError("initiator fin failed") from None
+    return ch, s_i_pub

@@ -55,6 +55,11 @@ class PeerManager:
         if ban:
             self.on_ban(node_id)
 
+    def score(self, node_id: str) -> float:
+        with self._lock:
+            info = self.peers.get(node_id)
+            return info.score if info is not None else 0.0
+
     def connected(self) -> list[PeerInfo]:
         with self._lock:
             return [p for p in self.peers.values() if not p.banned]

@@ -1,17 +1,25 @@
-"""Req/resp RPC (lighthouse_network/src/rpc: protocol.rs:236-266).
+"""Req/resp RPC over SSZ-snappy (lighthouse_network/src/rpc).
 
 Protocols: status, goodbye, ping, metadata, beacon_blocks_by_range,
-beacon_blocks_by_root. Payloads are zlib-compressed SSZ (the SSZ-snappy
-framing's role). Blocking request API with per-request ids + timeouts;
+beacon_blocks_by_root (protocol.rs:236-266).  The wire is binary:
+
+  request frame  (kind 2): [u32 req_id][u8 plen][protocol][snappy-frames(ssz)]
+  response frame (kind 3): [u32 req_id][u8 code][snappy-frames(body)]
+
+Payloads are spec-shaped SSZ wrapped in the snappy FRAMING format with
+CRC32C (rpc/codec/ssz_snappy.rs); block chunks carry the fork context
+byte.  Handlers keep the dict-level API (codec converts at the
+boundary); blocking request API with per-request ids + timeouts;
 token-bucket rate limiting per protocol (rpc/rate_limiter.rs).
 """
 from __future__ import annotations
 
-import json
+import struct
 import threading
 import time
-import zlib
 from dataclasses import dataclass
+
+from . import snappy
 
 
 @dataclass
@@ -35,6 +43,172 @@ class StatusMessage:
                    bytes.fromhex(d["finalized_root"]),
                    int(d["finalized_epoch"]),
                    bytes.fromhex(d["head_root"]), int(d["head_slot"]))
+
+
+# ---------------------------------------------------------------------------
+# per-protocol SSZ codecs (dict <-> canonical SSZ bytes)
+# ---------------------------------------------------------------------------
+
+def _enc_status(d: dict) -> bytes:
+    return (bytes.fromhex(d["fork_digest"])
+            + bytes.fromhex(d["finalized_root"])
+            + struct.pack("<Q", int(d["finalized_epoch"]))
+            + bytes.fromhex(d["head_root"])
+            + struct.pack("<Q", int(d["head_slot"])))
+
+
+def _dec_status(b: bytes) -> dict:
+    if len(b) != 84:
+        raise ValueError("bad status size")
+    return {"fork_digest": b[0:4].hex(), "finalized_root": b[4:36].hex(),
+            "finalized_epoch": struct.unpack_from("<Q", b, 36)[0],
+            "head_root": b[44:76].hex(),
+            "head_slot": struct.unpack_from("<Q", b, 76)[0]}
+
+
+def _enc_u64(key):
+    def enc(d):
+        return struct.pack("<Q", int((d or {}).get(key, 0)))
+
+    def dec(b):
+        if len(b) != 8:
+            raise ValueError("bad u64 payload")
+        return {key: struct.unpack("<Q", b)[0]}
+    return enc, dec
+
+
+def _enc_empty(_d) -> bytes:
+    return b""
+
+
+def _dec_empty(_b) -> dict:
+    return {}
+
+
+def _enc_metadata(d: dict) -> bytes:
+    attnets = bytes.fromhex((d or {}).get("attnets", "00"))
+    return struct.pack("<Q", int((d or {}).get("seq_number", 0))) \
+        + attnets[:8].ljust(8, b"\x00")
+
+
+def _dec_metadata(b: bytes) -> dict:
+    if len(b) != 16:
+        raise ValueError("bad metadata size")
+    return {"seq_number": struct.unpack_from("<Q", b)[0],
+            "attnets": b[8:16].hex()}
+
+
+def _enc_by_range(d: dict) -> bytes:
+    return struct.pack("<QQQ", int(d["start_slot"]), int(d["count"]),
+                       int(d.get("step", 1)))
+
+
+def _dec_by_range(b: bytes) -> dict:
+    if len(b) != 24:
+        raise ValueError("bad by_range size")
+    s, c, st = struct.unpack("<QQQ", b)
+    return {"start_slot": s, "count": c, "step": st}
+
+
+def _enc_by_root(d: dict) -> bytes:
+    roots = [bytes.fromhex(r) for r in d.get("roots", [])]
+    if any(len(r) != 32 for r in roots):
+        raise ValueError("bad root size")
+    return b"".join(roots)
+
+
+def _dec_by_root(b: bytes) -> dict:
+    if len(b) % 32:
+        raise ValueError("bad by_root size")
+    return {"roots": [b[i:i + 32].hex() for i in range(0, len(b), 32)]}
+
+
+def _enc_blocks(chunks: list) -> bytes:
+    """Response chunk list: [u32 len][fork-context-byte + ssz]* — each
+    entry is the hex string produced by sync.encode_block."""
+    out = bytearray()
+    for h in chunks or []:
+        raw = bytes.fromhex(h)
+        out += struct.pack("<I", len(raw)) + raw
+    return bytes(out)
+
+
+def _dec_blocks(b: bytes) -> list:
+    out = []
+    pos = 0
+    while pos < len(b):
+        if pos + 4 > len(b):
+            raise ValueError("truncated chunk header")
+        (length,) = struct.unpack_from("<I", b, pos)
+        pos += 4
+        if pos + length > len(b) or length > 16 * 1024 * 1024:
+            raise ValueError("bad chunk length")
+        out.append(b[pos:pos + length].hex())
+        pos += length
+    return out
+
+
+def _enc_identify(d: dict) -> bytes:
+    host = (d or {}).get("host", "").encode()
+    return struct.pack("<H", int((d or {}).get("port", 0))) + host
+
+
+def _dec_identify(b: bytes) -> dict:
+    if len(b) < 2 or len(b) > 2 + 255:
+        raise ValueError("bad identify size")
+    return {"port": struct.unpack_from("<H", b)[0],
+            "host": b[2:].decode()}
+
+
+def _enc_peer_list(entries: list) -> bytes:
+    """ENR-record-like entries: (node_id_hex, host, port)."""
+    out = bytearray()
+    for nid, host, port in entries or []:
+        nb, hb = bytes.fromhex(nid), host.encode()
+        out += bytes([len(nb)]) + nb + struct.pack("<H", int(port)) \
+            + bytes([len(hb)]) + hb
+    return bytes(out)
+
+
+def _dec_peer_list(b: bytes) -> list:
+    out = []
+    pos = 0
+    while pos < len(b):
+        nlen = b[pos]
+        if pos + 1 + nlen + 3 > len(b):
+            raise ValueError("truncated peer entry")
+        nid = b[pos + 1:pos + 1 + nlen].hex()
+        pos += 1 + nlen
+        (port,) = struct.unpack_from("<H", b, pos)
+        hlen = b[pos + 2]
+        if pos + 3 + hlen > len(b):
+            raise ValueError("truncated peer host")
+        host = b[pos + 3:pos + 3 + hlen].decode()
+        pos += 3 + hlen
+        out.append((nid, host, port))
+        if len(out) > 1024:
+            raise ValueError("peer list too long")
+    return out
+
+
+_PING_ENC, _PING_DEC = _enc_u64("seq")
+_GOODBYE_ENC, _GOODBYE_DEC = _enc_u64("reason")
+
+# protocol -> (enc_req, dec_req, enc_resp, dec_resp)
+CODECS: dict[str, tuple] = {
+    "status": (_enc_status, _dec_status, _enc_status, _dec_status),
+    "ping": (_PING_ENC, _PING_DEC, _PING_ENC, _PING_DEC),
+    "goodbye": (_GOODBYE_ENC, _GOODBYE_DEC, _enc_empty, _dec_empty),
+    "metadata": (_enc_empty, _dec_empty, _enc_metadata, _dec_metadata),
+    "beacon_blocks_by_range": (_enc_by_range, _dec_by_range,
+                               _enc_blocks, _dec_blocks),
+    "beacon_blocks_by_root": (_enc_by_root, _dec_by_root,
+                              _enc_blocks, _dec_blocks),
+    "discovery_identify": (_enc_identify, _dec_identify,
+                           _enc_empty, _dec_empty),
+    "discovery_peers": (_enc_empty, _dec_empty,
+                        _enc_peer_list, _dec_peer_list),
+}
 
 
 class RateLimiter:
@@ -63,9 +237,6 @@ class RateLimiter:
 
 
 class RpcHandler:
-    """Wire: frame kind 2 = request {id, protocol, payload}; kind 3 =
-    response {id, code, payload}. Handlers are registered per protocol."""
-
     REQ_FRAME = 2
     RESP_FRAME = 3
 
@@ -74,68 +245,98 @@ class RpcHandler:
         self.handlers: dict[str, callable] = {}
         self.rate_limiter = RateLimiter()
         self.on_rate_limited = lambda peer, protocol: None
-        self._pending: dict[int, list] = {}
+        self._pending: dict[int, tuple] = {}
+        self._req_proto: dict[int, str] = {}
         self._events: dict[int, threading.Event] = {}
         self._next_id = 0
         self._lock = threading.Lock()
 
     def register(self, protocol: str, handler) -> None:
-        """handler(peer, request_obj) -> response_obj (json-able)."""
+        """handler(peer, request_dict) -> response object (per codec)."""
         self.handlers[protocol] = handler
 
     def request(self, peer, protocol: str, payload: dict,
                 timeout: float = 10.0):
+        enc_req = CODECS[protocol][0]
+        # encode BEFORE registering the waiter: a codec error must not
+        # leak _events/_req_proto entries
+        body = snappy.compress_frames(enc_req(payload or {}))
         with self._lock:
             self._next_id += 1
             req_id = self._next_id
             ev = threading.Event()
             self._events[req_id] = ev
-        msg = zlib.compress(json.dumps(
-            {"id": req_id, "protocol": protocol,
-             "payload": payload}).encode())
+            self._req_proto[req_id] = protocol
+        proto_b = protocol.encode()
+        msg = struct.pack("<IB", req_id, len(proto_b)) + proto_b + body
         peer.send_frame(self.REQ_FRAME, msg)
         if not ev.wait(timeout):
             with self._lock:
                 self._events.pop(req_id, None)
                 self._pending.pop(req_id, None)
+                self._req_proto.pop(req_id, None)
             raise TimeoutError(f"rpc {protocol} timed out")
         with self._lock:
             self._events.pop(req_id, None)
+            self._req_proto.pop(req_id, None)
             code, resp = self._pending.pop(req_id)
         if code != 0:
-            raise RuntimeError(f"rpc error {code}: {resp}")
+            raise RuntimeError(f"rpc error {code}")
         return resp
 
     def handle_frame(self, peer, kind: int, payload: bytes) -> None:
-        try:
-            msg = json.loads(zlib.decompress(payload))
-        except (ValueError, zlib.error):
-            return
-        if not isinstance(msg, dict) or "id" not in msg:
-            return
         if kind == self.REQ_FRAME:
-            protocol = msg.get("protocol", "?")
-            if not self.rate_limiter.allow(peer.node_id, protocol):
-                self.on_rate_limited(peer, protocol)
-                self._respond(peer, msg["id"], 429, "rate limited")
-                return
-            handler = self.handlers.get(protocol)
-            if handler is None:
-                self._respond(peer, msg["id"], 404, "unknown protocol")
-                return
-            try:
-                resp = handler(peer, msg.get("payload"))
-                self._respond(peer, msg["id"], 0, resp)
-            except Exception as e:
-                self._respond(peer, msg["id"], 500, repr(e))
+            self._handle_request(peer, payload)
         elif kind == self.RESP_FRAME:
-            with self._lock:
-                ev = self._events.get(msg["id"])
-                if ev is not None:
-                    self._pending[msg["id"]] = (msg["code"], msg.get("payload"))
-                    ev.set()
+            self._handle_response(peer, payload)
 
-    def _respond(self, peer, req_id: int, code: int, payload) -> None:
-        msg = zlib.compress(json.dumps(
-            {"id": req_id, "code": code, "payload": payload}).encode())
-        peer.send_frame(self.RESP_FRAME, msg)
+    def _handle_request(self, peer, payload: bytes) -> None:
+        try:
+            req_id, plen = struct.unpack_from("<IB", payload, 0)
+            protocol = payload[5:5 + plen].decode()
+            body = payload[5 + plen:]
+        except (struct.error, UnicodeDecodeError):
+            return
+        if not self.rate_limiter.allow(peer.node_id, protocol):
+            self.on_rate_limited(peer, protocol)
+            self._respond(peer, req_id, 429, b"")
+            return
+        codec = CODECS.get(protocol)
+        handler = self.handlers.get(protocol)
+        if codec is None or handler is None:
+            self._respond(peer, req_id, 404, b"")
+            return
+        try:
+            req = codec[1](snappy.decompress_frames(body))
+            resp = handler(peer, req)
+            self._respond(peer, req_id, 0,
+                          snappy.compress_frames(codec[2](resp)))
+        except Exception:
+            self._respond(peer, req_id, 500, b"")
+
+    def _handle_response(self, peer, payload: bytes) -> None:
+        try:
+            req_id, code = struct.unpack_from("<IB", payload, 0)
+            body = payload[5:]
+        except struct.error:
+            return
+        with self._lock:
+            ev = self._events.get(req_id)
+            protocol = self._req_proto.get(req_id)
+        if ev is None or protocol is None:
+            return
+        resp = None
+        if code == 0:
+            try:
+                resp = CODECS[protocol][3](snappy.decompress_frames(body))
+            except (ValueError, KeyError, IndexError, struct.error,
+                    UnicodeDecodeError):
+                code = 502          # undecodable response
+        with self._lock:
+            if req_id in self._events:
+                self._pending[req_id] = (code, resp)
+                ev.set()
+
+    def _respond(self, peer, req_id: int, code: int, body: bytes) -> None:
+        peer.send_frame(self.RESP_FRAME,
+                        struct.pack("<IB", req_id, code) + body)

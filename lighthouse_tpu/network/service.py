@@ -51,13 +51,13 @@ class NetworkService:
 
         self.transport.on_peer = self._on_peer
         self.transport.on_frame = self._on_frame
-        self.transport.on_disconnect = \
-            lambda p: self.peers.on_disconnect(p.node_id)
+        self.transport.on_disconnect = self._on_disconnect
         self.gossip.validator = self._validate_gossip
         self.gossip.on_message = self._deliver_gossip
         self.gossip.on_validation_result = \
             lambda peer, topic, result: self.peers.report(peer.node_id,
                                                           result)
+        self.gossip.peer_score = self.peers.score
         self.rpc.on_rate_limited = \
             lambda peer, proto: self.peers.report(peer.node_id,
                                                   "rate_limited")
@@ -87,10 +87,12 @@ class NetworkService:
 
     def start(self) -> None:
         self.transport.start()
+        self.gossip.start_heartbeat()
         for (host, port) in (self.config.boot_nodes or []):
             self.dial(host, port)
 
     def stop(self) -> None:
+        self.gossip.stop()
         self.transport.stop()
 
     def dial(self, host: str, port: int):
@@ -101,8 +103,13 @@ class NetworkService:
 
     def _on_peer(self, peer) -> None:
         self.peers.on_connect(peer.node_id)
+        self.gossip.on_peer_connected(peer)
         threading.Thread(target=self._status_exchange, args=(peer,),
                          daemon=True).start()
+
+    def _on_disconnect(self, peer) -> None:
+        self.peers.on_disconnect(peer.node_id)
+        self.gossip.on_peer_disconnected(peer.node_id)
 
     def _on_frame(self, peer, kind: int, payload: bytes) -> None:
         if kind == GossipEngine.GOSSIP_FRAME:
@@ -134,8 +141,9 @@ class NetworkService:
             return
         if status.fork_digest != self.gossip.fork_digest:
             try:
-                self.rpc.request(peer, "goodbye",
-                                 {"reason": "irrelevant_network"},
+                # spec goodbye reason codes: 1 shutdown, 2 irrelevant
+                # network, 3 fault/error
+                self.rpc.request(peer, "goodbye", {"reason": 2},
                                  timeout=2.0)
             except (TimeoutError, RuntimeError):
                 pass

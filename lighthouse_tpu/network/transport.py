@@ -1,33 +1,50 @@
-"""Framed TCP transport.
+"""Encrypted framed TCP transport.
 
-Frames: [u32 len][u8 kind][payload]. kind: 0 = handshake, 1 = gossip,
-2 = rpc request, 3 = rpc response. Each peer connection runs a reader
-thread dispatching into the owning service's handlers.
+Connection setup runs the noise-like handshake (network/noise.py): peers
+are identified by sha256(static_pub)[:8] — an AUTHENTICATED id, not a
+self-claimed one.  After the handshake every frame is one AEAD envelope:
+
+    [u32 ciphertext_len][ciphertext]
+    plaintext = [u8 kind][payload]        kind: 1 gossip, 2 rpc-req,
+                                                3 rpc-resp
+
+Per-direction nonce counters + transcript-bound associated data give
+ordering/splicing protection; a tampered frame fails AEAD and drops the
+connection (ref role: lighthouse_network/src/service/utils.rs noise XX).
 """
 from __future__ import annotations
 
-import json
 import socket
 import struct
 import threading
-import uuid
+
+from .noise import (
+    HandshakeError, NodeIdentity, initiator_handshake, node_id_of,
+    responder_handshake,
+)
+
+# Sealed-frame cap: must fit a max-size gossip payload AFTER snappy's
+# worst-case ~0.8% expansion on incompressible data, and a full
+# max_request_blocks by_range response packed into one frame.
+MAX_FRAME = 64 * 1024 * 1024 + 4096
 
 
 class Peer:
     def __init__(self, sock: socket.socket, addr, node_id: str,
-                 outbound: bool):
+                 channel, outbound: bool):
         self.sock = sock
         self.addr = addr
         self.node_id = node_id
+        self.channel = channel
         self.outbound = outbound
         self._send_lock = threading.Lock()
         self.alive = True
 
     def send_frame(self, kind: int, payload: bytes) -> None:
-        frame = struct.pack("<IB", len(payload) + 1, kind) + payload
         with self._send_lock:
             try:
-                self.sock.sendall(frame)
+                sealed = self.channel.seal(bytes([kind]) + payload)
+                self.sock.sendall(struct.pack("<I", len(sealed)) + sealed)
             except OSError:
                 self.alive = False
 
@@ -44,8 +61,9 @@ class Transport:
     `on_frame(peer, kind, payload)`."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 node_id: str | None = None):
-        self.node_id = node_id or uuid.uuid4().hex[:16]
+                 identity: NodeIdentity | None = None):
+        self.identity = identity or NodeIdentity()
+        self.node_id = self.identity.node_id
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.listener.bind((host, port))
@@ -81,32 +99,28 @@ class Transport:
 
     def _handshake_in(self, sock, addr) -> None:
         try:
-            kind, payload = _read_frame(sock)
-            if kind != 0:
-                sock.close()
-                return
-            hello = json.loads(payload)
-            sock.sendall(_frame(0, json.dumps(
-                {"node_id": self.node_id}).encode()))
-            peer = Peer(sock, addr, hello["node_id"], outbound=False)
+            sock.settimeout(10)
+            channel, remote_static = responder_handshake(
+                sock.sendall, lambda n: _read_exact(sock, n), self.identity)
+            sock.settimeout(None)
+            peer = Peer(sock, addr, node_id_of(remote_static), channel,
+                        outbound=False)
             self._register(peer)
-        except (OSError, ValueError, KeyError):
+        except (OSError, ValueError, HandshakeError):
             sock.close()
 
     def dial(self, host: str, port: int) -> Peer | None:
         try:
             sock = socket.create_connection((host, port), timeout=5)
-            sock.sendall(_frame(0, json.dumps(
-                {"node_id": self.node_id}).encode()))
-            kind, payload = _read_frame(sock)
-            if kind != 0:
-                sock.close()
-                return None
-            hello = json.loads(payload)
-            peer = Peer(sock, (host, port), hello["node_id"], outbound=True)
+            sock.settimeout(10)
+            channel, remote_static = initiator_handshake(
+                sock.sendall, lambda n: _read_exact(sock, n), self.identity)
+            sock.settimeout(None)
+            peer = Peer(sock, (host, port), node_id_of(remote_static),
+                        channel, outbound=True)
             self._register(peer)
             return peer
-        except (OSError, ValueError, KeyError):
+        except (OSError, ValueError, HandshakeError):
             return None
 
     def _register(self, peer: Peer) -> None:
@@ -119,33 +133,26 @@ class Transport:
         import logging
         try:
             while peer.alive and not self._stop:
-                kind, payload = _read_frame(peer.sock)
+                hdr = _read_exact(peer.sock, 4)
+                (length,) = struct.unpack("<I", hdr)
+                if length > MAX_FRAME:
+                    raise ValueError("frame too large")
+                sealed = _read_exact(peer.sock, length)
+                plain = peer.channel.open(sealed)  # tampering -> drop conn
+                kind, payload = plain[0], plain[1:]
                 try:
                     self.on_frame(peer, kind, payload)
                 except Exception:
                     # a handler bug must not kill the reader / skip cleanup
                     logging.getLogger("lighthouse_tpu.network").exception(
                         "frame handler failed (peer %s)", peer.node_id)
-        except (OSError, ValueError):
+        except (OSError, ValueError, HandshakeError, IndexError):
             pass
         peer.alive = False
         # a redialed peer may have replaced this entry — only pop ourselves
         if self.peers.get(peer.node_id) is peer:
             self.peers.pop(peer.node_id, None)
             self.on_disconnect(peer)
-
-
-def _frame(kind: int, payload: bytes) -> bytes:
-    return struct.pack("<IB", len(payload) + 1, kind) + payload
-
-
-def _read_frame(sock) -> tuple[int, bytes]:
-    hdr = _read_exact(sock, 5)
-    (length, kind) = struct.unpack("<IB", hdr)
-    if length > 64 * 1024 * 1024:
-        raise ValueError("frame too large")
-    payload = _read_exact(sock, length - 1)
-    return kind, payload
 
 
 def _read_exact(sock, n: int) -> bytes:
