@@ -74,8 +74,12 @@ def test_garbage_gossip_downscores_and_bans():
     try:
         peer = nb.dial("127.0.0.1", na.port)
         assert _wait(lambda: na.peers.connected())
-        # B floods garbage block gossip; A must reject and eventually ban
+        # B floods garbage block gossip; A must reject and eventually ban.
+        # Mesh publish only targets peers KNOWN to subscribe — wait for
+        # A's SUBSCRIBE control messages to land first.
         from lighthouse_tpu.network.gossip import Topic
+        assert _wait(lambda: any(Topic.BLOCK in tps
+                                 for tps in nb.gossip.peer_topics.values()))
         for i in range(8):
             nb.gossip.publish(Topic.BLOCK, b"garbage" + bytes([i]))
         assert _wait(lambda: any(
