@@ -337,6 +337,14 @@ class ApiBackend:
     def head_fork_version(self) -> bytes:
         return self.chain.head().head_state.fork.current_version
 
+    def prepare_beacon_proposer(self, entries: list[dict]) -> None:
+        """POST /eth/v1/validator/prepare_beacon_proposer."""
+        self.chain.register_proposer_preparation(entries)
+
+    def register_validator(self, registrations: list[dict]) -> None:
+        """POST /eth/v1/validator/register_validator (builder flow)."""
+        self.chain.register_validators(registrations)
+
     def seen_liveness(self, indices: list[int], epoch: int) -> list[bool]:
         return [self.chain.observed_attesters.has_been_observed(epoch, i)
                 for i in indices]

@@ -222,6 +222,12 @@ def _make_handler(backend: ApiBackend):
                     agg = deserialize(agg_t, body)
                     backend.publish_aggregate(agg)
                     return self._json(200, {})
+                if url.path == "/eth/v1/validator/prepare_beacon_proposer":
+                    backend.prepare_beacon_proposer(json.loads(body))
+                    return self._json(200, {})
+                if url.path == "/eth/v1/validator/register_validator":
+                    backend.register_validator(json.loads(body))
+                    return self._json(200, {})
                 return self._json(404, {"message": "route not found"})
             except ApiError as e:
                 return self._json(e.status, {"message": str(e)})

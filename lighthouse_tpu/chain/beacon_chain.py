@@ -133,6 +133,15 @@ class BeaconChain:
         from .data_availability import DataAvailabilityChecker
         self.data_availability_checker = DataAvailabilityChecker(self.T)
         self.block_times: dict[bytes, dict] = {}
+        # proposer preparation + MEV builder (execution_layer/src/lib.rs:807
+        # get_payload builder path; validator registrations forwarded to the
+        # builder, fee recipients applied to local payloads)
+        self.prepared_proposers: dict[int, bytes] = {}   # idx -> recipient
+        self.validator_registrations: dict[bytes, dict] = {}
+        self.builder = None                    # BuilderHttpClient | None
+        self.builder_boost_factor = 100        # percent
+        self.default_fee_recipient = b"\x00" * 20
+        self.block_production_log: list[dict] = []   # payload source audit
         from .validator_monitor import ValidatorMonitor
         self.validator_monitor = ValidatorMonitor(self)
         self._monitored_epoch = 0
@@ -713,7 +722,8 @@ class BeaconChain:
                     produce_sync_aggregate(max(slot, 1) - 1, parent_root)
             body.sync_aggregate = sync_aggregate
         if fork >= ForkName.BELLATRIX:
-            body.execution_payload = self._produce_payload(state, fork)
+            body.execution_payload = self._payload_for_block(
+                state, fork, proposer_index)
 
         block = T.BeaconBlock[fork](
             slot=slot, proposer_index=proposer_index,
@@ -731,19 +741,140 @@ class BeaconChain:
             sync_committee_bits=[False] * self.spec.preset.sync_committee_size,
             sync_committee_signature=bls.INFINITY_SIGNATURE)
 
-    def _produce_payload(self, state: BeaconState, fork: ForkName):
+    # -- proposer preparation + builder/MEV ----------------------------------
+
+    LOCAL_PAYLOAD_VALUE_WEI = 10**9   # mock-EL local block value
+
+    def register_proposer_preparation(self, entries) -> None:
+        """prepare_beacon_proposer VC->BN plumbing
+        (validator_client/src/preparation_service.rs)."""
+        for e in entries:
+            idx = int(e["validator_index"])
+            fee = e["fee_recipient"]
+            if isinstance(fee, str):
+                fee = bytes.fromhex(fee[2:] if fee.startswith("0x") else fee)
+            self.prepared_proposers[idx] = fee
+
+    def register_validators(self, registrations: list[dict]) -> None:
+        """SignedValidatorRegistration intake; forwarded to the builder."""
+        for r in registrations:
+            msg = r.get("message", r)
+            self.validator_registrations[msg["pubkey"]] = r
+        if self.builder is not None:
+            self.builder.register_validators(registrations)
+
+    def fee_recipient_for(self, proposer_index: int) -> bytes:
+        return self.prepared_proposers.get(int(proposer_index),
+                                           self.default_fee_recipient)
+
+    def prepare_payload_attributes(self, next_slot: int) -> None:
+        """Per-slot payload-attribute preparation: tell the EL who
+        proposes next so payload building starts early
+        (execution_layer payload-attributes flow)."""
+        if self.head().head_state.fork_name < ForkName.BELLATRIX:
+            return
+        st = self.head().head_state
+        scratch = st.copy()
+        if scratch.slot < next_slot:
+            process_slots(scratch, next_slot)
+        proposer = get_beacon_proposer_index(scratch, next_slot)
+        if proposer not in self.prepared_proposers:
+            return
+        head_hash = st.latest_execution_payload_header.block_hash
+        # engine-API PayloadAttributes shape (camelCase, 0x-hex) so the
+        # REAL EngineApiClient can serialize it, not just the mock
+        attrs = {
+            "timestamp": hex(compute_timestamp_at_slot(scratch, next_slot)),
+            "prevRandao": "0x" + scratch.get_randao_mix(
+                scratch.current_epoch()).hex(),
+            "suggestedFeeRecipient": "0x"
+            + self.fee_recipient_for(proposer).hex(),
+        }
+        if scratch.fork_name >= ForkName.CAPELLA:
+            withdrawals, _ = get_expected_withdrawals(scratch)
+            attrs["withdrawals"] = [{
+                "index": hex(w.index),
+                "validatorIndex": hex(w.validator_index),
+                "address": "0x" + w.address.hex(),
+                "amount": hex(w.amount)} for w in withdrawals]
+        self.execution_layer.notify_forkchoice_updated(
+            head_hash, head_hash, head_hash, payload_attributes=attrs)
+
+    def build_payload_on_parent(self, slot: int, parent_hash: bytes,
+                                fee_recipient: bytes,
+                                extra_entropy: bytes = b""):
+        """Deterministic payload construction on an execution parent (the
+        mock builder and the local path share this)."""
+        st = self.head().head_state
+        if st.latest_execution_payload_header.block_hash != parent_hash:
+            raise BlockError(INVALID_BLOCK,
+                             "unknown execution parent for payload")
+        scratch = st.copy()
+        if scratch.slot < slot:
+            process_slots(scratch, slot)
+        return self._produce_payload(scratch, scratch.fork_name,
+                                     fee_recipient, extra_entropy)
+
+    def _payload_for_block(self, state: BeaconState, fork: ForkName,
+                           proposer_index: int):
+        """Local payload vs builder bid (execution_layer/src/lib.rs:807):
+        take the builder's when its boosted value beats the local one."""
+        fee = self.fee_recipient_for(proposer_index)
+        local = self._produce_payload(state, fork, fee)
+        source = "local"
+        payload = local
+        pubkey = state.validators.pubkey(proposer_index)
+        registered = "0x" + pubkey.hex() in self.validator_registrations
+        if self.builder is not None and registered:
+            # ANY builder fault degrades to the local payload — a proposer
+            # must never miss its slot because of the builder
+            try:
+                parent_hash = \
+                    state.latest_execution_payload_header.block_hash
+                bid = self.builder.get_header(state.slot, parent_hash,
+                                              pubkey)
+                if bid is not None and \
+                        bid["value"] * self.builder_boost_factor // 100 > \
+                        self.LOCAL_PAYLOAD_VALUE_WEI:
+                    block_hash = bytes.fromhex(
+                        bid["header"]["blockHash"][2:])
+                    pj = self.builder.submit_blinded_block(block_hash)
+                    if pj is not None:
+                        from ..execution_layer.execution_layer import (
+                            payload_from_json,
+                        )
+                        payload = payload_from_json(self.T, fork, pj)
+                        source = "builder"
+            except Exception:
+                import logging
+                logging.getLogger("lighthouse_tpu.chain").warning(
+                    "builder flow failed; using local payload",
+                    exc_info=True)
+                payload, source = local, "local"
+        self.block_production_log.append(
+            {"slot": state.slot, "source": source,
+             "fee_recipient": payload.fee_recipient})
+        return payload
+
+    def _produce_payload(self, state: BeaconState, fork: ForkName,
+                         fee_recipient: bytes = b"\x00" * 20,
+                         extra_entropy: bytes = b""):
         """Local mock-EL payload (the real EL round-trip lives in
         lighthouse_tpu.execution_layer)."""
+        import hashlib
         cls = self.T.ExecutionPayload[fork]
         parent_hash = state.latest_execution_payload_header.block_hash
+        block_hash = hashlib.sha256(
+            b"payload" + state.slot.to_bytes(8, "little") + parent_hash
+            + fee_recipient + extra_entropy).digest()
         kw = dict(
             parent_hash=parent_hash,
+            fee_recipient=fee_recipient,
             prev_randao=state.get_randao_mix(state.current_epoch()),
             block_number=state.latest_execution_payload_header.block_number
             + 1,
             timestamp=compute_timestamp_at_slot(state, state.slot),
-            block_hash=htr(self.T.Checkpoint(epoch=state.slot,
-                                             root=parent_hash)),
+            block_hash=block_hash,
             base_fee_per_gas=7)
         if fork >= ForkName.CAPELLA:
             withdrawals, _ = get_expected_withdrawals(state)

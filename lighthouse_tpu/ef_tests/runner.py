@@ -384,7 +384,11 @@ def _h_bls(spec, fork, handler, case: _Case) -> None:
     inp, expect = data["input"], data["output"]
     backend = bls.get_backend()
     if backend.name == "fake":
-        backend = bls.set_backend("python")
+        # conformance needs real crypto, but never leak the switch into
+        # the caller's process-global backend
+        prev = backend
+        backend = bls._make("python")
+        assert bls.get_backend() is prev
 
     def hx(s):
         return bytes.fromhex(s[2:] if s.startswith("0x") else s)

@@ -41,6 +41,39 @@ def _payload_to_json(payload) -> dict:
     return out
 
 
+def payload_from_json(T, fork, j: dict):
+    """Inverse of _payload_to_json (engine-API / builder JSON -> SSZ)."""
+    def hx(s):
+        return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+    kw = dict(
+        parent_hash=hx(j["parentHash"]),
+        fee_recipient=hx(j["feeRecipient"]),
+        state_root=hx(j["stateRoot"]),
+        receipts_root=hx(j["receiptsRoot"]),
+        logs_bloom=hx(j["logsBloom"]),
+        prev_randao=hx(j["prevRandao"]),
+        block_number=int(j["blockNumber"], 16),
+        gas_limit=int(j["gasLimit"], 16),
+        gas_used=int(j["gasUsed"], 16),
+        timestamp=int(j["timestamp"], 16),
+        extra_data=hx(j["extraData"]),
+        base_fee_per_gas=int(j["baseFeePerGas"], 16),
+        block_hash=hx(j["blockHash"]),
+        transactions=[hx(t) for t in j["transactions"]],
+    )
+    if "withdrawals" in j:
+        kw["withdrawals"] = [T.Withdrawal(
+            index=int(w["index"], 16),
+            validator_index=int(w["validatorIndex"], 16),
+            address=hx(w["address"]), amount=int(w["amount"], 16))
+            for w in j["withdrawals"]]
+    if "blobGasUsed" in j:
+        kw["blob_gas_used"] = int(j["blobGasUsed"], 16)
+        kw["excess_blob_gas"] = int(j["excessBlobGas"], 16)
+    return T.ExecutionPayload[fork](**kw)
+
+
 class ExecutionLayer(ExecutionLayerInterface):
     def __init__(self, client: EngineApiClient):
         self.client = client

@@ -88,6 +88,12 @@ class ValidatorClient:
         self.published_attestations = 0
         self.published_aggregates = 0
         self.published_sync_messages = 0
+        # preparation service (validator_client/src/preparation_service.rs)
+        self.fee_recipients: dict[bytes, bytes] = {}   # pubkey -> 20B
+        self.default_fee_recipient: bytes | None = None
+        self.builder_proposals = False
+        self.gas_limit = 30_000_000
+        self._prepared_epoch = -1
 
     # -- duties --------------------------------------------------------------
 
@@ -128,10 +134,50 @@ class ValidatorClient:
             self.doppelganger.update(epoch, any(live))
             if not self.doppelganger.allows_signing(epoch):
                 return
+        if epoch > self._prepared_epoch:
+            self.prepare_proposers(epoch)
         self.propose_if_due(slot)
         self.attest(slot)
         self.aggregate(slot)
         self.sync_committee_duty(slot)
+
+    def _fee_recipient(self, pubkey: bytes) -> bytes | None:
+        return self.fee_recipients.get(pubkey, self.default_fee_recipient)
+
+    def prepare_proposers(self, epoch: int) -> None:
+        """Preparation service: push fee recipients (and, when builder
+        proposals are enabled, signed validator registrations) to the BN
+        once per epoch (preparation_service.rs)."""
+        entries = []
+        for pk, idx in self._indices.items():
+            fee = self._fee_recipient(pk)
+            if fee is not None:
+                entries.append({"validator_index": idx,
+                                "fee_recipient": "0x" + fee.hex()})
+        if entries:
+            try:
+                self.nodes.first_success("prepare_beacon_proposer", entries)
+            except Exception:
+                return              # retry next slot, not next epoch
+        if self.builder_proposals:
+            regs = []
+            import time as _time
+            for pk in self.store.voting_pubkeys():
+                fee = self._fee_recipient(pk) or b"\x00" * 20
+                msg = {"fee_recipient": "0x" + fee.hex(),
+                       "gas_limit": self.gas_limit,
+                       "timestamp": int(_time.time()),
+                       "pubkey": "0x" + pk.hex()}
+                regs.append({
+                    "message": msg,
+                    "signature": "0x" + self.store.sign_validator_registration(
+                        pk, msg).hex()})
+            if regs:
+                try:
+                    self.nodes.first_success("register_validator", regs)
+                except Exception:
+                    return
+        self._prepared_epoch = epoch
 
     def sync_committee_duty(self, slot: int) -> None:
         """Sign the head root with every of our validators in the current

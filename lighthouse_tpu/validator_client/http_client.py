@@ -140,3 +140,11 @@ class BeaconNodeHttpClient(BeaconNodeInterface):
         qs = "&".join(f"id={i}" for i in indices)
         out = self._req("GET", f"/eth/v1/validator/liveness/{epoch}?{qs}")
         return out["data"]
+
+    def prepare_beacon_proposer(self, entries: list[dict]) -> None:
+        self._req("POST", "/eth/v1/validator/prepare_beacon_proposer",
+                  json_body=entries)
+
+    def register_validator(self, registrations: list[dict]) -> None:
+        self._req("POST", "/eth/v1/validator/register_validator",
+                  json_body=registrations)

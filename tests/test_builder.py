@@ -1,0 +1,142 @@
+"""Builder/MEV + proposer-preparation flows (VERDICT r1 item 8).
+
+Mirrors execution_layer/src/lib.rs:807 (get_payload builder-vs-local),
+test_utils/mock_builder.rs, and preparation_service.rs behaviors.
+"""
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.execution_layer.builder import (
+    BuilderHttpClient, MockBuilder,
+)
+from lighthouse_tpu.specs import minimal_spec
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def _bellatrix_harness():
+    spec = minimal_spec(altair_fork_epoch=0, bellatrix_fork_epoch=0)
+    return BeaconChainHarness(spec, 32)
+
+
+def test_prepared_fee_recipient_lands_in_local_payload():
+    h = _bellatrix_harness()
+    chain = h.chain
+    fee = b"\xaa" * 20
+    chain.register_proposer_preparation(
+        [{"validator_index": i, "fee_recipient": "0x" + fee.hex()}
+         for i in range(32)])
+    h.extend_chain(2)
+    payload = chain.head().head_block.message.body.execution_payload
+    assert payload.fee_recipient == fee
+    assert chain.block_production_log[-1]["source"] == "local"
+    # payload-attribute preparation reaches the EL with the recipient
+    chain.prepare_payload_attributes(chain.slot() + 1)
+    assert any(c for c in chain.execution_layer.forkchoice_calls)
+
+
+def test_builder_outbids_local_payload():
+    h = _bellatrix_harness()
+    chain = h.chain
+    mock = MockBuilder(chain, bid_wei=chain.LOCAL_PAYLOAD_VALUE_WEI * 10)
+    url = mock.start_http()
+    try:
+        chain.builder = BuilderHttpClient(url)
+        builder_fee = b"\xbb" * 20
+        regs = [{"message": {
+            "fee_recipient": "0x" + builder_fee.hex(),
+            "gas_limit": 30_000_000, "timestamp": 0,
+            "pubkey": "0x" + chain.head().head_state.validators
+            .pubkey(i).hex()}, "signature": "0x" + "00" * 96}
+            for i in range(32)]
+        chain.register_validators(regs)
+        assert mock.registrations          # forwarded to the builder
+        h.extend_chain(2)
+        payload = chain.head().head_block.message.body.execution_payload
+        assert chain.block_production_log[-1]["source"] == "builder"
+        assert payload.fee_recipient == builder_fee
+        assert mock.header_requests and mock.unblind_requests
+    finally:
+        mock.stop()
+
+
+def test_low_bid_falls_back_to_local():
+    h = _bellatrix_harness()
+    chain = h.chain
+    mock = MockBuilder(chain, bid_wei=1)   # below the local value
+    url = mock.start_http()
+    try:
+        chain.builder = BuilderHttpClient(url)
+        chain.register_validators([{"message": {
+            "fee_recipient": "0x" + "bb" * 20,
+            "gas_limit": 30_000_000, "timestamp": 0,
+            "pubkey": "0x" + chain.head().head_state.validators
+            .pubkey(i).hex()}} for i in range(32)])
+        h.extend_chain(2)
+        assert chain.block_production_log[-1]["source"] == "local"
+        assert mock.header_requests        # the bid WAS solicited
+        assert not mock.unblind_requests   # but never taken
+    finally:
+        mock.stop()
+
+
+def test_unregistered_proposer_gets_no_bid():
+    h = _bellatrix_harness()
+    chain = h.chain
+    mock = MockBuilder(chain, bid_wei=10**18)
+    url = mock.start_http()
+    try:
+        chain.builder = BuilderHttpClient(url)
+        h.extend_chain(2)
+        assert chain.block_production_log[-1]["source"] == "local"
+        assert not mock.header_requests    # no registration -> not asked
+    finally:
+        mock.stop()
+
+
+def test_vc_preparation_service_over_http():
+    """VC pushes prepare_beacon_proposer + register_validator each epoch;
+    produced blocks carry the VC-configured fee recipient."""
+    from lighthouse_tpu.api import BeaconApiServer
+    from lighthouse_tpu.api.backend import ApiBackend
+    from lighthouse_tpu.validator_client import (
+        BeaconNodeFallback, BeaconNodeHttpClient, ValidatorClient,
+        ValidatorStore,
+    )
+    spec = minimal_spec(altair_fork_epoch=0, bellatrix_fork_epoch=0)
+    h = BeaconChainHarness(spec, 32)
+    chain = h.chain
+    mock = MockBuilder(chain, bid_wei=chain.LOCAL_PAYLOAD_VALUE_WEI * 5)
+    chain.builder = BuilderHttpClient(mock.start_http())
+    srv = BeaconApiServer(ApiBackend(chain))
+    srv.start()
+    try:
+        client = BeaconNodeHttpClient(f"http://127.0.0.1:{srv.port}", spec)
+        store = ValidatorStore(spec, chain.genesis_validators_root)
+        for sk in h.secret_keys:
+            store.add_validator(sk)
+        vc = ValidatorClient(spec, store, BeaconNodeFallback([client]))
+        vc.default_fee_recipient = b"\xcc" * 20
+        vc.builder_proposals = True
+        for _ in range(spec.preset.slots_per_epoch + 2):
+            h.advance_slot()
+            vc.on_slot(chain.slot())
+            chain.recompute_head()
+        # BN saw the preparation and registrations
+        assert chain.prepared_proposers
+        assert chain.validator_registrations
+        # builder got the registrations and won at least one block
+        assert mock.registrations
+        assert any(e["source"] == "builder"
+                   for e in chain.block_production_log)
+        # the registered fee recipient is in the produced payloads
+        assert any(e["fee_recipient"] == b"\xcc" * 20
+                   for e in chain.block_production_log)
+    finally:
+        srv.stop()
+        mock.stop()
