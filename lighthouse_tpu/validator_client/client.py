@@ -93,6 +93,7 @@ class ValidatorClient:
         self.default_fee_recipient: bytes | None = None
         self.builder_proposals = False
         self.gas_limit = 30_000_000
+        self.graffiti: dict[bytes, str] = {}   # keymanager per-key graffiti
         self._prepared_epoch = -1
 
     # -- duties --------------------------------------------------------------
@@ -140,6 +141,17 @@ class ValidatorClient:
         self.attest(slot)
         self.aggregate(slot)
         self.sync_committee_duty(slot)
+
+    def sign_voluntary_exit(self, pubkey: bytes, validator_index: int,
+                            epoch: int) -> dict:
+        """Keymanager POST /eth/v1/validator/{pubkey}/voluntary_exit."""
+        from ..containers import get_types
+        T = get_types(self.spec.preset)
+        msg = T.VoluntaryExit(epoch=epoch, validator_index=validator_index)
+        sig = self.store.sign_voluntary_exit(pubkey, msg)
+        return {"message": {"epoch": str(epoch),
+                            "validator_index": str(validator_index)},
+                "signature": "0x" + sig.hex()}
 
     def _fee_recipient(self, pubkey: bytes) -> bytes | None:
         return self.fee_recipients.get(pubkey, self.default_fee_recipient)
@@ -217,9 +229,12 @@ class ValidatorClient:
             if pk is None:
                 continue
             reveal = self.store.randao_reveal(pk, slot // spe)
+            graffiti = None
+            if self.graffiti.get(pk):
+                graffiti = self.graffiti[pk].encode()[:32].ljust(32, b"\0")
             try:
                 block = self.nodes.first_success("produce_block", slot,
-                                                 reveal)
+                                                 reveal, graffiti)
                 sig = self.store.sign_block(pk, block)
             except SlashingError:
                 continue

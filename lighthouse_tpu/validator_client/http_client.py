@@ -79,11 +79,13 @@ class BeaconNodeHttpClient(BeaconNodeInterface):
         idx = out["data"]["index"]
         return int(idx) if idx is not None else None
 
-    def produce_block(self, slot: int, randao_reveal: bytes):
+    def produce_block(self, slot: int, randao_reveal: bytes,
+                      graffiti: bytes | None = None):
+        params = {"randao_reveal": "0x" + randao_reveal.hex()}
+        if graffiti:
+            params["graffiti"] = "0x" + graffiti.hex()
         raw = self._req("GET", f"/eth/v2/validator/blocks/{slot}?"
-                        + urlencode({"randao_reveal":
-                                     "0x" + randao_reveal.hex()}),
-                        raw=True)
+                        + urlencode(params), raw=True)
         fork = self.spec.fork_name_at_slot(slot)
         return deserialize(self.T.BeaconBlock[fork].ssz_type, raw)
 

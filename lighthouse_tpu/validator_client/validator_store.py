@@ -36,8 +36,19 @@ class ValidatorStore:
         self.slashing_db.register_validator(pk)
         return pk
 
+    def add_remote_validator(self, pubkey: bytes, url: str) -> None:
+        """Web3Signer-backed key: only the URL is held locally
+        (signing_method.rs remote path); slashing protection stays here."""
+        if not hasattr(self, "_remote_keys"):
+            self._remote_keys: dict[bytes, str] = {}
+        self._remote_keys[pubkey] = url
+        self.slashing_db.register_validator(pubkey)
+
+    def remove_remote_validator(self, pubkey: bytes) -> None:
+        getattr(self, "_remote_keys", {}).pop(pubkey, None)
+
     def voting_pubkeys(self) -> list[bytes]:
-        return list(self._keys)
+        return list(self._keys) + list(getattr(self, "_remote_keys", {}))
 
     def set_fork_version(self, version: bytes) -> None:
         self._fork_version = version
@@ -48,9 +59,13 @@ class ValidatorStore:
 
     def _sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
         sk = self._keys.get(pubkey)
-        if sk is None:
-            raise SlashingError("unknown validator key")
-        return bls.sign(sk, signing_root)
+        if sk is not None:
+            return bls.sign(sk, signing_root)
+        url = getattr(self, "_remote_keys", {}).get(pubkey)
+        if url is not None:
+            from .remote_signer import remote_sign
+            return remote_sign(url, pubkey, signing_root)
+        raise SlashingError("unknown validator key")
 
     # -- gated signing -------------------------------------------------------
 
