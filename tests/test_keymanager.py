@@ -126,6 +126,14 @@ def test_fee_recipient_and_gas_limit_routes(km):
     got = _req(srv, "GET", f"/eth/v1/validator/{pkh}/graffiti")
     assert got["data"]["graffiti"] == "hello"
     # keymanager-initiated voluntary exit is signed and well-formed
+    # (the index must be KNOWN — unknown indices are refused, never
+    # defaulted to someone else's validator 0)
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(srv, "POST", f"/eth/v1/validator/{pkh}/voluntary_exit",
+             {"epoch": 11})
+    assert e.value.code == 400
+    vc._indices[pk] = 7
     sve = _req(srv, "POST", f"/eth/v1/validator/{pkh}/voluntary_exit",
                {"epoch": 11})["data"]
     assert sve["message"]["epoch"] == "11"
