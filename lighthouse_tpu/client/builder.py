@@ -125,8 +125,7 @@ class ClientBuilder:
         # slasher
         if cfg.slasher_enabled:
             client.slasher = Slasher(SlasherConfig(),
-                                     n_validators=len(
-                                         client.chain.genesis_state.validators))
+                                     store=client.chain.store.hot)
 
         # network, fed through the priority beacon processor
         from ..beacon_processor import BeaconProcessor

@@ -1,39 +1,45 @@
 """The slasher core.
 
-Design (slasher/src/{slasher.rs:21, array.rs:16-28} re-thought array-first):
-for each validator we track, per epoch, the minimum target and maximum target
-of any attestation whose source covers that epoch. A new attestation
-(source s, target t) by validator v is:
+Design (slasher/src/{slasher.rs:21, array.rs:16-28}): for each validator
+we track, per epoch, min-target and max-target distance matrices:
 
-- surrounded by a prior vote   if  min_target[v, s] > t ... (prior has
-  source < s and target > t)
-- surrounds a prior vote       if  max_target[v, s] < t and max exists
-  (prior has source > s and target < t)
-- a double vote                if a different attestation with the same
-  target exists.
+  min_target[v][e] = min target among v's attestations with source >= e
+  max_target[v][e] = max target among v's attestations with source <= e
 
-The reference stores zlib-compressed 2D chunks in LMDB; here the matrix is a
-dense numpy (validators × history) pair of uint16 distance arrays updated
-with vectorized column sweeps, persisted to the native KV store in chunks.
-Attestations are ingested in batches from a queue (attestation_queue.rs) on
-each `process_queued(current_epoch)` call.
+  new (s,t) SURROUNDS a prior vote    iff min_target[v][s+1] < t
+  new (s,t) IS SURROUNDED by a prior  iff max_target[v][s-1] > t
+
+Storage is the reference's disk-scale layout re-done over the native C++
+KV engine: the matrices are 2D-chunked (validator_chunk_size x
+chunk_size), zlib-compressed per chunk, pulled through a bounded LRU
+cache and flushed after each batch — memory stays O(cache), not
+O(validators x history).  Update sweeps run per epoch-chunk with the
+reference's early-stop: a chunk left unchanged ends the sweep (distances
+are monotone along the sweep direction).  Attestations are ingested in
+batches from a queue (attestation_queue.rs) on each
+`process_queued(current_epoch)` call.
 """
 from __future__ import annotations
 
 import struct
 import threading
+import zlib
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..ssz import htr
 
+_NONE_MIN = np.iinfo(np.uint16).max
+
 
 @dataclass
 class SlasherConfig:
     history_length: int = 4096          # epochs of history
-    chunk_size: int = 16
-    validator_chunk_size: int = 256
+    chunk_size: int = 16                # epochs per chunk
+    validator_chunk_size: int = 256     # validators per chunk
+    cache_chunks: int = 256             # LRU cap (chunks held in memory)
     max_db_size_mb: int = 1024
 
 
@@ -45,16 +51,158 @@ class SlashingRecord:
     attestation_2: object      # new offender
 
 
+class ChunkedArray:
+    """One distance matrix as compressed (vchunk, echunk) tiles in the KV
+    store with a bounded in-memory LRU (slasher/src/array.rs:16-28)."""
+
+    def __init__(self, store, tag: bytes, config: SlasherConfig,
+                 default: int):
+        self.store = store
+        self.tag = tag
+        self.cfg = config
+        self.default = np.uint16(default)
+        self._cache: OrderedDict[tuple[int, int], np.ndarray] = \
+            OrderedDict()
+        self._dirty: set[tuple[int, int]] = set()
+        self._written: set[tuple[int, int]] = set()  # store keys we own
+
+    def _key(self, vc: int, ec: int) -> bytes:
+        return b"slasher:" + self.tag + struct.pack("<QQ", vc, ec)
+
+    def chunk(self, vc: int, ec: int) -> np.ndarray:
+        ck = (vc, ec)
+        arr = self._cache.get(ck)
+        if arr is not None:
+            self._cache.move_to_end(ck)
+            return arr
+        raw = self.store.get(self._key(vc, ec)) if self.store else None
+        if raw is not None:
+            arr = np.frombuffer(zlib.decompress(raw), np.uint16).reshape(
+                self.cfg.validator_chunk_size, self.cfg.chunk_size).copy()
+        else:
+            arr = np.full((self.cfg.validator_chunk_size,
+                           self.cfg.chunk_size), self.default, np.uint16)
+        self._cache[ck] = arr
+        self._evict()
+        return arr
+
+    def mark_dirty(self, vc: int, ec: int) -> None:
+        self._dirty.add((vc, ec))
+
+    def _evict(self) -> None:
+        if self.store is None:
+            # storeless (tests/dev): evicting a dirty chunk would DISCARD
+            # slashing state — keep dirty chunks resident, evict clean only
+            clean = [ck for ck in self._cache if ck not in self._dirty]
+            while len(self._cache) > self.cfg.cache_chunks and clean:
+                self._cache.pop(clean.pop(0), None)
+            return
+        while len(self._cache) > self.cfg.cache_chunks:
+            ck, arr = self._cache.popitem(last=False)
+            if ck in self._dirty:
+                self._flush_one(ck, arr)
+
+    def _flush_one(self, ck: tuple[int, int], arr: np.ndarray) -> None:
+        if self.store is None:
+            return       # storeless: stays dirty (and cache-resident)
+        self.store.put(self._key(*ck),
+                       zlib.compress(arr.tobytes(), level=3))
+        self._written.add(ck)
+        self._dirty.discard(ck)
+
+    def flush(self) -> None:
+        if self.store is None:
+            return          # storeless: dirty chunks stay cache-resident
+        for ck in list(self._dirty):
+            arr = self._cache.get(ck)
+            if arr is not None:
+                self._flush_one(ck, arr)
+        self._dirty.clear()
+
+    def read_column(self, idxs: np.ndarray, epoch: int) -> np.ndarray:
+        """Values at one epoch column for a set of validators."""
+        vcs = idxs // self.cfg.validator_chunk_size
+        ec = epoch // self.cfg.chunk_size
+        off_e = epoch % self.cfg.chunk_size
+        out = np.empty(len(idxs), np.uint16)
+        for vc in np.unique(vcs):
+            sel = vcs == vc
+            arr = self.chunk(int(vc), int(ec))
+            out[sel] = arr[idxs[sel] % self.cfg.validator_chunk_size, off_e]
+        return out
+
+    def update_sweep(self, idxs: np.ndarray, start_epoch: int,
+                     stop_epoch: int, step: int, target: int) -> None:
+        """Write distance-to-`target` into columns from start toward stop
+        (inclusive), one vectorized tile write per (vchunk, echunk),
+        stopping early when a whole epoch-chunk needed no update
+        (monotone distances make further sweeping a no-op — the
+        reference's early-stop)."""
+        is_min = int(self.default) == _NONE_MIN
+        merge = np.minimum if is_min else np.maximum
+        grouped = []                      # hoisted: (vc, rows) once
+        for vc in np.unique(idxs // self.cfg.validator_chunk_size):
+            sel = idxs[(idxs // self.cfg.validator_chunk_size) == vc]
+            grouped.append((int(vc),
+                            sel % self.cfg.validator_chunk_size))
+        e = start_epoch
+        while (step > 0 and e <= stop_epoch) or \
+                (step < 0 and e >= stop_epoch):
+            ec = e // self.cfg.chunk_size
+            if step > 0:
+                e_edge = min(stop_epoch, (ec + 1) * self.cfg.chunk_size - 1)
+                epochs = np.arange(e, e_edge + 1)
+                e_next = e_edge + 1
+            else:
+                e_edge = max(stop_epoch, ec * self.cfg.chunk_size)
+                epochs = np.arange(e_edge, e + 1)
+                e_next = e_edge - 1
+            cols = epochs % self.cfg.chunk_size
+            dist = np.clip(target - epochs, 0,
+                           _NONE_MIN - 1 if is_min else _NONE_MIN)
+            dist = dist.astype(np.uint16)
+            chunk_changed = False
+            for vc, rows in grouped:
+                arr = self.chunk(vc, int(ec))
+                tile = arr[np.ix_(rows, cols)]
+                merged = merge(tile, dist[None, :])
+                if (merged != tile).any():
+                    arr[np.ix_(rows, cols)] = merged
+                    self.mark_dirty(vc, int(ec))
+                    chunk_changed = True
+            if not chunk_changed:
+                return                       # early stop
+            e = e_next
+
+    def prune_before(self, min_epoch: int) -> None:
+        """Drop cached AND stored chunks before the history window.
+        Store keys written this process are tracked in _written; keys
+        from a previous process linger (bounded by the history length at
+        the time of that shutdown) until their epochs are rewritten."""
+        min_ec = min_epoch // self.cfg.chunk_size
+        for ck in [c for c in self._cache if c[1] < min_ec]:
+            self._cache.pop(ck, None)
+            self._dirty.discard(ck)
+        if self.store is not None:
+            stale = [ck for ck in self._written if ck[1] < min_ec]
+            for ck in stale:
+                try:
+                    self.store.delete(self._key(*ck))
+                except Exception:
+                    pass
+                self._written.discard(ck)
+
+    def cache_bytes(self) -> int:
+        return sum(a.nbytes for a in self._cache.values())
+
+
 class Slasher:
-    def __init__(self, config: SlasherConfig | None = None, store=None,
-                 n_validators: int = 0):
+    def __init__(self, config: SlasherConfig | None = None, store=None):
         self.config = config or SlasherConfig()
         self.store = store
-        H = self.config.history_length
-        # distances stored relative to epoch (bounded by history window)
-        self._min_target = np.full((n_validators, H), np.iinfo(np.uint16).max,
-                                   dtype=np.uint16)
-        self._max_target = np.zeros((n_validators, H), dtype=np.uint16)
+        self.min_target = ChunkedArray(store, b"min", self.config,
+                                       _NONE_MIN)
+        self.max_target = ChunkedArray(store, b"max", self.config, 0)
         # (validator, target) -> (data_root, data) for double-vote detection
         self._by_target: dict[tuple[int, int], tuple[bytes, object]] = {}
         self._queue: list = []
@@ -62,18 +210,6 @@ class Slasher:
         self._block_queue: list = []
         self._lock = threading.Lock()
         self.slashings: list[SlashingRecord] = []
-
-    def _ensure_capacity(self, n: int) -> None:
-        cur = self._min_target.shape[0]
-        if n <= cur:
-            return
-        H = self.config.history_length
-        grow = n - cur
-        self._min_target = np.vstack(
-            [self._min_target,
-             np.full((grow, H), np.iinfo(np.uint16).max, np.uint16)])
-        self._max_target = np.vstack(
-            [self._max_target, np.zeros((grow, H), np.uint16)])
 
     # -- ingestion -----------------------------------------------------------
 
@@ -102,19 +238,21 @@ class Slasher:
             if rec:
                 found.append(rec)
         self.slashings.extend(found)
+        # flush dirty chunks + prune double-vote/bookkeeping history
+        self.min_target.flush()
+        self.max_target.flush()
+        lo = current_epoch - self.config.history_length
+        if lo > 0:
+            self.min_target.prune_before(lo)
+            self.max_target.prune_before(lo)
+            self._by_target = {k: v for k, v in self._by_target.items()
+                               if k[1] >= lo}
+        self.slashings = self.slashings[-4096:]
         return found
 
     def _process_attestation(self, indexed,
                              current_epoch: int) -> list[SlashingRecord]:
-        """Matrix semantics (slasher design):
-        min_target[v][e] = min target among v's attestations with source >= e
-        max_target[v][e] = max target among v's attestations with source <= e
-
-        new (s,t) SURROUNDS a prior vote    iff min_target[v][s+1] < t
-        new (s,t) IS SURROUNDED by a prior  iff max_target[v][s-1] > t
-        """
         H = self.config.history_length
-        NONE_MIN = np.iinfo(np.uint16).max
         s = indexed.data.source.epoch
         t = indexed.data.target.epoch
         if t > current_epoch or s > t:
@@ -128,7 +266,6 @@ class Slasher:
                           dtype=np.int64)
         if len(idxs) == 0:
             return []
-        self._ensure_capacity(int(idxs.max()) + 1)
 
         # double votes
         for v in idxs:
@@ -139,42 +276,23 @@ class Slasher:
             else:
                 self._by_target[(int(v), t)] = (data_root, indexed)
 
-        # distances are stored relative to the column epoch, capped by H
+        # distances are stored relative to the column epoch
         if s + 1 <= current_epoch:
-            col = (s + 1) % H
-            mins = self._min_target[idxs, col].astype(np.int64)
-            surrounds = (mins != NONE_MIN) & (mins + s + 1 < t)
+            mins = self.min_target.read_column(idxs, s + 1).astype(np.int64)
+            surrounds = (mins != _NONE_MIN) & (mins + s + 1 < t)
             for v in idxs[surrounds]:
                 out.append(SlashingRecord("surrounds", int(v), None,
                                           indexed))
         if s >= 1:
-            col = (s - 1) % H
-            maxs = self._max_target[idxs, col].astype(np.int64)
+            maxs = self.max_target.read_column(idxs, s - 1).astype(np.int64)
             surrounded = (maxs > 0) & (maxs + s - 1 > t)
             for v in idxs[surrounded]:
                 out.append(SlashingRecord("surrounded", int(v), None,
                                           indexed))
 
-        # update min_target for e <= s and max_target for e >= s over the
-        # whole history window (full sweeps — the reference's chunked
-        # early-stop optimization is a TODO; correctness first)
         lo = max(0, current_epoch - H + 1)
-        for e in range(s, lo - 1, -1):
-            col = e % H
-            d = np.uint16(min(t - e, NONE_MIN - 1))
-            cur = self._min_target[idxs, col]
-            upd = cur > d
-            if upd.any():
-                self._min_target[idxs[upd], col] = d
-        for e in range(s, current_epoch + 1):
-            col = e % H
-            # targets at/below the column epoch can never participate in a
-            # surround; clamp to 0 (= "no relevant entry")
-            d = np.uint16(t - e) if t >= e else np.uint16(0)
-            cur = self._max_target[idxs, col]
-            upd = cur < d
-            if upd.any():
-                self._max_target[idxs[upd], col] = d
+        self.min_target.update_sweep(idxs, s, lo, -1, t)
+        self.max_target.update_sweep(idxs, s, current_epoch, +1, t)
         return out
 
     def _process_block(self, signed_header) -> SlashingRecord | None:
@@ -193,21 +311,13 @@ class Slasher:
     # -- persistence ---------------------------------------------------------
 
     def persist(self) -> None:
-        if self.store is None:
-            return
-        self.store.put(b"slasher:min", self._min_target.tobytes())
-        self.store.put(b"slasher:max", self._max_target.tobytes())
-        self.store.put(b"slasher:shape",
-                       struct.pack("<QQ", *self._min_target.shape))
+        """Chunks stream to the KV store as they are evicted/flushed; this
+        just forces a final flush (old dense-matrix persist is gone)."""
+        self.min_target.flush()
+        self.max_target.flush()
 
     def restore(self) -> None:
-        if self.store is None:
-            return
-        shape = self.store.get(b"slasher:shape")
-        if shape is None:
-            return
-        n, H = struct.unpack("<QQ", shape)
-        self._min_target = np.frombuffer(
-            self.store.get(b"slasher:min"), np.uint16).reshape(n, H).copy()
-        self._max_target = np.frombuffer(
-            self.store.get(b"slasher:max"), np.uint16).reshape(n, H).copy()
+        """Nothing to do: chunks load lazily from the store by key."""
+
+    def memory_bytes(self) -> int:
+        return self.min_target.cache_bytes() + self.max_target.cache_bytes()

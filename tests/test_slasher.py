@@ -20,7 +20,7 @@ def att(indices, source, target, root=b"\x11" * 32):
 
 
 def make():
-    return Slasher(SlasherConfig(history_length=64), n_validators=16)
+    return Slasher(SlasherConfig(history_length=64))
 
 
 def test_double_vote_detected():
@@ -83,11 +83,81 @@ def test_proposer_equivocation():
 
 def test_persistence_roundtrip():
     store = MemoryStore()
-    s = Slasher(SlasherConfig(history_length=64), store=store,
-                n_validators=8)
+    s = Slasher(SlasherConfig(history_length=64), store=store)
     s.accept_attestation(att([1], 3, 4))
     s.process_queued(10)
     s.persist()
     s2 = Slasher(SlasherConfig(history_length=64), store=store)
     s2.restore()
-    assert (s2._min_target == s._min_target).all()
+    # chunks load lazily from the store: a surround by a prior vote that
+    # only the OLD instance ingested must still be detected by the new one
+    import numpy as np
+    idxs = np.array([1], dtype=np.int64)
+    assert (s2.min_target.read_column(idxs, 3)
+            == s.min_target.read_column(idxs, 3)).all()
+    s2.accept_attestation(att([1], 2, 6))   # surrounds the stored (3,4)
+    found = s2.process_queued(10)
+    assert any(r.kind == "surrounds" for r in found)
+
+
+def test_disk_scale_bounded_memory():
+    """VERDICT r1 item 10: detection at >=100k validators with memory
+    bounded by the chunk cache, not O(validators * history)."""
+    import numpy as np
+    store = MemoryStore()
+    cfg = SlasherConfig(history_length=4096, cache_chunks=64)
+    s = Slasher(cfg, store=store)
+    n = 100_000
+    # a committee-sized slice of a 100k-validator set attests per epoch;
+    # indices spread across the whole registry
+    rng = np.random.default_rng(5)
+    for epoch in range(6, 16):
+        idxs = rng.choice(n, size=512, replace=False)
+        s.accept_attestation(att(list(map(int, idxs)),
+                                 epoch - 1, epoch))
+        s.process_queued(epoch)
+    # memory: bounded by the LRU (64 chunks x 256x16 u16 x 2 arrays)
+    cap = 2 * cfg.cache_chunks * cfg.validator_chunk_size \
+        * cfg.chunk_size * 2
+    assert s.memory_bytes() <= cap, s.memory_bytes()
+    # a surround by validator 42 against its earlier (5,6)-style votes:
+    v = int(rng.choice(n))
+    s.accept_attestation(att([v], 14, 15))
+    s.process_queued(16)
+    s.accept_attestation(att([v], 13, 17))   # surrounds (14,15)
+    found = s.process_queued(17)
+    assert any(r.kind == "surrounds" and r.validator_index == v
+               for r in found)
+    # and a surrounded detection
+    s.accept_attestation(att([v], 12, 18))
+    s.accept_attestation(att([v], 13, 16))
+    found = s.process_queued(18)
+    assert any(r.kind == "surrounded" for r in found)
+
+
+def test_huge_epoch_no_overflow():
+    """A mainnet-scale epoch (> uint16 range) must not crash the batch
+    (review r2: np.uint16(t - e) OverflowError DoS)."""
+    s = Slasher(SlasherConfig(history_length=64))
+    s.accept_attestation(att([1], 0, 400_000))
+    s.process_queued(400_000)     # must not raise
+    s.accept_attestation(att([1], 399_990, 399_995))
+    s.process_queued(400_000)
+
+
+def test_storeless_eviction_keeps_dirty_state():
+    """Without a KV store, LRU pressure must never discard dirty chunks
+    (that would silently disable surround detection)."""
+    import numpy as np
+    cfg = SlasherConfig(history_length=4096, cache_chunks=4)
+    s = Slasher(cfg)
+    s.accept_attestation(att([0], 3, 4))
+    s.process_queued(10)
+    # touch many distinct validator chunks to pressure the cache
+    for i in range(1, 40):
+        s.accept_attestation(att([i * cfg.validator_chunk_size], 5, 6))
+        s.process_queued(10)
+    s.accept_attestation(att([0], 2, 6))    # surrounds the original (3,4)
+    found = s.process_queued(10)
+    assert any(r.kind == "surrounds" and r.validator_index == 0
+               for r in found)
