@@ -16,9 +16,13 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="lighthouse_tpu",
         description="TPU-native Ethereum consensus client")
+    from .specs.networks import NETWORKS
     parser.add_argument("--network", default="minimal",
-                        choices=["mainnet", "minimal"],
+                        choices=sorted(NETWORKS),
                         help="baked-in network config")
+    parser.add_argument("--testnet-dir", default=None,
+                        help="custom network dir with config.yaml "
+                             "(overrides --network)")
     parser.add_argument("--log-level", default="INFO")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -52,6 +56,19 @@ def main(argv=None):
     am_new.add_argument("--count", type=int, default=1)
     am_new.add_argument("--out", default="keystores")
     am_new.add_argument("--password", default="")
+    am_wnew = am_sub.add_parser("wallet_new", help="EIP-2386 hd wallet")
+    am_wnew.add_argument("--name", required=True)
+    am_wnew.add_argument("--password", default="")
+    am_wnew.add_argument("--wallet-dir", default="wallets")
+    am_wlist = am_sub.add_parser("wallet_list")
+    am_wlist.add_argument("--wallet-dir", default="wallets")
+    am_vc = am_sub.add_parser("validator_create",
+                              help="derive next validator from a wallet")
+    am_vc.add_argument("--name", required=True)
+    am_vc.add_argument("--password", default="")
+    am_vc.add_argument("--keystore-password", default="")
+    am_vc.add_argument("--wallet-dir", default="wallets")
+    am_vc.add_argument("--out", default="keystores")
 
     bnode = sub.add_parser("boot_node", help="standalone discovery bootnode")
     bnode.add_argument("--host", default="127.0.0.1")
@@ -87,8 +104,12 @@ def main(argv=None):
 
     args = parser.parse_args(argv)
 
-    from .specs import mainnet_spec, minimal_spec
-    spec = mainnet_spec() if args.network == "mainnet" else minimal_spec()
+    if args.testnet_dir:
+        from .specs.networks import load_testnet_dir
+        spec = load_testnet_dir(args.testnet_dir)
+    else:
+        from .specs.networks import network_spec
+        spec = network_spec(args.network)
 
     if args.cmd in ("beacon_node", "bn", "beacon"):
         return _run_beacon_node(spec, args)
@@ -189,6 +210,11 @@ def _run_beacon_node(spec, args):
         slasher_enabled=args.slasher, crypto_backend=args.crypto_backend,
         interop_validator_count=args.interop_validators,
         genesis_time=args.genesis_time)
+    if args.testnet_dir:
+        from .specs.networks import testnet_genesis_state
+        st = testnet_genesis_state(args.testnet_dir, spec)
+        if st is not None:
+            cfg.genesis_state = st
     if args.checkpoint_state:
         cfg.checkpoint_sync_state = open(args.checkpoint_state, "rb").read()
         if args.checkpoint_block:
@@ -256,6 +282,29 @@ def _run_account_manager(spec, args):
     import os
     from .crypto import bls
     from .crypto.keystore import create_keystore
+    if args.am_cmd == "wallet_new":
+        from .crypto.wallet import WalletManager
+        wm = WalletManager(args.wallet_dir)
+        w = wm.create(args.name, args.password.encode())
+        print(json.dumps({"name": w.name, "uuid": w.data["uuid"]}))
+        return 0
+    if args.am_cmd == "wallet_list":
+        from .crypto.wallet import WalletManager
+        print(json.dumps(WalletManager(args.wallet_dir).list()))
+        return 0
+    if args.am_cmd == "validator_create":
+        from .crypto.wallet import WalletManager
+        wm = WalletManager(args.wallet_dir)
+        w = wm.open(args.name)
+        ks = w.next_validator_keystore(args.password.encode(),
+                                       args.keystore_password.encode())
+        wm.save(w)                     # persist the nextaccount bump
+        os.makedirs(args.out, exist_ok=True)
+        path = os.path.join(args.out, f"keystore-{ks['pubkey'][:12]}.json")
+        with open(path, "w") as f:
+            json.dump(ks, f, indent=2)
+        print(f"wrote {path}")
+        return 0
     os.makedirs(args.out, exist_ok=True)
     for i in range(args.count):
         sk = bls.keygen_interop(i)

@@ -77,3 +77,27 @@ class MetricsServer:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+
+
+class timer:
+    """Context-manager histogram timer for hot sections, the
+    lighthouse_metrics::start_timer equivalent:
+
+        with metrics.timer("beacon_block_processing_seconds"):
+            ...
+    """
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help_ = help_
+
+    def __enter__(self):
+        import time
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+        observe(self.name, time.perf_counter() - self._t0,
+                self.help_ or self.name)
+        return False

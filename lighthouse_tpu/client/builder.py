@@ -38,6 +38,7 @@ class ClientConfig:
     checkpoint_sync_block: bytes | None = None
     interop_validator_count: int = 0
     genesis_time: int | None = None
+    genesis_state: object | None = None     # testnet-dir genesis.ssz
 
 
 class Client:
@@ -109,6 +110,8 @@ class ClientBuilder:
                     T.SignedBeaconBlock[ForkName(braw[0])].ssz_type,
                     braw[1:])
             cb.weak_subjectivity_anchor(state, blk)
+        elif cfg.genesis_state is not None:
+            cb.genesis_state(cfg.genesis_state)
         elif cfg.interop_validator_count:
             cb.interop_genesis(
                 [bls.keygen_interop(i)

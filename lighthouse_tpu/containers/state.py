@@ -253,6 +253,9 @@ class ValidatorRegistry:
     def hash_tree_root(self, registry_limit: int) -> bytes:
         if not self._dirty and self._root_cache is not None:
             return self._root_cache
+        import sys
+        import time
+        t0 = time.perf_counter()
         from ..ops import sha256 as k
         n = len(self)
         if n == 0:
@@ -267,6 +270,10 @@ class ValidatorRegistry:
                 k.words_to_chunks(np.asarray(root_words)), n)
         self._root_cache = root
         self._dirty = False
+        m = sys.modules.get("lighthouse_tpu.api.metrics")
+        if m is not None:
+            m.observe("validator_registry_tree_hash_seconds",
+                      time.perf_counter() - t0)
         return root
 
     def serialize(self) -> bytes:

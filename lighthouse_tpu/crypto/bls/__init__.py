@@ -254,7 +254,18 @@ def aggregate_verify(pks, msgs, sig) -> bool:
 
 
 def verify_signature_sets(sets: list[SignatureSet]) -> bool:
-    return get_backend().verify_signature_sets(sets)
+    # hot-path timing (beacon_chain/src/metrics.rs style); recorded only
+    # when the metrics module is live, so library use stays weightless
+    import sys
+    import time
+    t0 = time.perf_counter()
+    out = get_backend().verify_signature_sets(sets)
+    m = sys.modules.get("lighthouse_tpu.api.metrics")
+    if m is not None:
+        m.observe("bls_batch_verify_seconds", time.perf_counter() - t0)
+        m.observe("bls_batch_verify_set_count", len(sets))
+        m.inc_counter("bls_batch_verify_total")
+    return out
 
 
 def aggregate_signatures(sigs) -> bytes:

@@ -21,39 +21,30 @@ def _scrypt(password: bytes, salt: bytes) -> bytes:
                           maxmem=64 * 1024 * 1024 * 2)
 
 
-def create_keystore(sk: int, password: bytes,
-                    path: str = "m/12381/3600/0/0/0") -> dict:
+def encrypt_secret(secret: bytes, password: bytes) -> dict:
+    """EIP-2335 crypto envelope over raw secret bytes (also the seed
+    envelope for EIP-2386 wallets)."""
     salt = os.urandom(32)
     iv = os.urandom(16)
     dk = _scrypt(password, salt)
-    secret = sk.to_bytes(32, "big")
     cipher = Cipher(algorithms.AES(dk[:16]), modes.CTR(iv))
     enc = cipher.encryptor()
     ciphertext = enc.update(secret) + enc.finalize()
     checksum = hashlib.sha256(dk[16:32] + ciphertext).hexdigest()
-    pubkey = bls.sk_to_pk(sk)
     return {
-        "crypto": {
-            "kdf": {"function": "scrypt",
-                    "params": {"dklen": 32, "n": 16384, "p": 1, "r": 8,
-                               "salt": salt.hex()},
-                    "message": ""},
-            "checksum": {"function": "sha256", "params": {},
-                         "message": checksum},
-            "cipher": {"function": "aes-128-ctr",
-                       "params": {"iv": iv.hex()},
-                       "message": ciphertext.hex()},
-        },
-        "description": "lighthouse_tpu keystore",
-        "pubkey": pubkey.hex(),
-        "path": path,
-        "uuid": str(uuid.uuid4()),
-        "version": 4,
+        "kdf": {"function": "scrypt",
+                "params": {"dklen": 32, "n": 16384, "p": 1, "r": 8,
+                           "salt": salt.hex()},
+                "message": ""},
+        "checksum": {"function": "sha256", "params": {},
+                     "message": checksum},
+        "cipher": {"function": "aes-128-ctr",
+                   "params": {"iv": iv.hex()},
+                   "message": ciphertext.hex()},
     }
 
 
-def decrypt_keystore(keystore: dict, password: bytes) -> int:
-    crypto = keystore["crypto"]
+def decrypt_secret(crypto: dict, password: bytes) -> bytes:
     if crypto["kdf"]["function"] != "scrypt":
         raise ValueError("unsupported kdf")
     params = crypto["kdf"]["params"]
@@ -68,5 +59,22 @@ def decrypt_keystore(keystore: dict, password: bytes) -> int:
     iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
     cipher = Cipher(algorithms.AES(dk[:16]), modes.CTR(iv))
     dec = cipher.decryptor()
-    secret = dec.update(ciphertext) + dec.finalize()
-    return int.from_bytes(secret, "big")
+    return dec.update(ciphertext) + dec.finalize()
+
+
+def create_keystore(sk: int, password: bytes,
+                    path: str = "m/12381/3600/0/0/0") -> dict:
+    pubkey = bls.sk_to_pk(sk)
+    return {
+        "crypto": encrypt_secret(sk.to_bytes(32, "big"), password),
+        "description": "lighthouse_tpu keystore",
+        "pubkey": pubkey.hex(),
+        "path": path,
+        "uuid": str(uuid.uuid4()),
+        "version": 4,
+    }
+
+
+def decrypt_keystore(keystore: dict, password: bytes) -> int:
+    return int.from_bytes(decrypt_secret(keystore["crypto"], password),
+                          "big")

@@ -292,7 +292,9 @@ class Slasher:
 
         lo = max(0, current_epoch - H + 1)
         self.min_target.update_sweep(idxs, s, lo, -1, t)
-        self.max_target.update_sweep(idxs, s, current_epoch, +1, t)
+        # clamp the upward sweep into the history window too: an ancient
+        # source must not materialize O(current_epoch) chunks
+        self.max_target.update_sweep(idxs, max(s, lo), current_epoch, +1, t)
         return out
 
     def _process_block(self, signed_header) -> SlashingRecord | None:

@@ -39,6 +39,8 @@ class WatchMonitor:
         head = chain.head()
         added = 0
         with self._lock:
+            if head.head_state.slot <= self._last_slot:
+                return 0           # nothing new: no summary, no commit
             for slot in range(self._last_slot + 1,
                               head.head_state.slot + 1):
                 root = chain.block_root_at_slot(slot)
@@ -118,3 +120,87 @@ class WatchMonitor:
                 "SELECT slot FROM canonical_blocks WHERE slot BETWEEN ? "
                 "AND ?", (start_slot, end_slot))}
         return [s for s in range(start_slot, end_slot + 1) if s not in have]
+
+
+class WatchServer:
+    """HTTP front for the monitor DB (watch/src/server in the reference):
+
+      GET /v1/blocks/{slot}            one canonical block row
+      GET /v1/blocks?start=&end=       reward rows for a range
+      GET /v1/validators/proposers     top proposers
+      GET /v1/epochs/{epoch}           participation summary
+      GET /v1/slots/missed?start=&end= missed slots
+    """
+
+    def __init__(self, monitor: WatchMonitor, host: str = "127.0.0.1",
+                 port: int = 0):
+        import json
+        import threading
+        from http.server import (
+            BaseHTTPRequestHandler, ThreadingHTTPServer,
+        )
+        from urllib.parse import parse_qs, urlparse
+        mon = monitor
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                q = parse_qs(url.query)
+                try:
+                    mon.update()
+                    if url.path == "/v1/blocks":
+                        rows = mon.block_rewards_range(
+                            int(q["start"][0]), int(q["end"][0]))
+                        return self._json(200, {"data": [
+                            {"slot": r[0], "proposer_index": r[1],
+                             "attestations": r[2],
+                             "sync_participation": r[3]} for r in rows]})
+                    if url.path.startswith("/v1/blocks/"):
+                        slot = int(url.path.rsplit("/", 1)[1])
+                        rows = mon.block_rewards_range(slot, slot)
+                        if not rows:
+                            return self._json(404, {"message": "no block"})
+                        r = rows[0]
+                        return self._json(200, {"data": {
+                            "slot": r[0], "proposer_index": r[1]}})
+                    if url.path == "/v1/validators/proposers":
+                        return self._json(200, {"data": [
+                            {"validator_index": v, "blocks": n}
+                            for v, n in mon.top_proposers(
+                                int(q.get("limit", [10])[0]))]})
+                    if url.path.startswith("/v1/epochs/"):
+                        epoch = int(url.path.rsplit("/", 1)[1])
+                        part = mon.participation(epoch)
+                        if part is None:
+                            return self._json(404, {"message": "no epoch"})
+                        return self._json(200, {"data": {
+                            "epoch": epoch, "participation": part[0]}})
+                    if url.path == "/v1/slots/missed":
+                        return self._json(200, {"data": mon.missed_slots(
+                            int(q["start"][0]), int(q["end"][0]))})
+                    return self._json(404, {"message": "route not found"})
+                except Exception as e:
+                    return self._json(400, {"message": repr(e)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

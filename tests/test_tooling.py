@@ -69,3 +69,145 @@ def test_watch_monitor():
     assert mon.missed_slots(1, 16) == []
     part = mon.participation(h.chain.head().head_state.previous_epoch())
     assert part is not None and part[0] > 0.9
+
+
+def test_eip2386_wallet_roundtrip(tmp_path):
+    """EIP-2386 hd wallet: create, derive sequential validators at the
+    EIP-2334 paths, keystore export, nextaccount persistence."""
+    from lighthouse_tpu.crypto import bls
+    bls.set_backend("python")
+    from lighthouse_tpu.crypto.key_derivation import derive_path
+    from lighthouse_tpu.crypto.keystore import decrypt_keystore
+    from lighthouse_tpu.crypto.wallet import Wallet, WalletManager
+    wm = WalletManager(str(tmp_path))
+    w = wm.create("primary", b"wpass")
+    assert wm.list() == ["primary"]
+    assert w.nextaccount == 0
+    i0, v0, wd0 = w.derive_validator(b"wpass")
+    i1, v1, _ = w.derive_validator(b"wpass")
+    assert (i0, i1) == (0, 1) and v0 != v1 and v0 != wd0
+    # derivation matches EIP-2334 paths from the decrypted seed
+    from lighthouse_tpu.crypto.wallet import decrypt_seed
+    seed = decrypt_seed(w.data, b"wpass")
+    assert v0 == derive_path(seed, "m/12381/3600/0/0/0")
+    assert wd0 == derive_path(seed, "m/12381/3600/0/0")
+    # keystore export decrypts back to the derived voting key
+    ks = w.next_validator_keystore(b"wpass", b"kpass")
+    assert decrypt_keystore(ks, b"kpass") == derive_path(
+        seed, "m/12381/3600/2/0/0")
+    wm.save(w)
+    # persistence of nextaccount across reopen
+    w2 = wm.open("primary")
+    assert w2.nextaccount == 3
+    # wrong password rejected
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        w2.derive_validator(b"wrong")
+
+
+def test_network_configs_and_testnet_dir(tmp_path):
+    """Baked-in named networks + testnet-dir config.yaml loading
+    (common/eth2_network_config/src/lib.rs:32-53)."""
+    from lighthouse_tpu.specs.networks import (
+        load_testnet_dir, network_spec,
+    )
+    sep = network_spec("sepolia")
+    assert sep.genesis_fork_version == bytes.fromhex("90000069")
+    assert sep.capella_fork_epoch == 56832
+    hol = network_spec("holesky")
+    assert hol.altair_fork_epoch == 0
+    assert network_spec("mainnet").config_name == "mainnet"
+    with pytest.raises(ValueError):
+        network_spec("nonsense")
+    (tmp_path / "config.yaml").write_text(
+        "PRESET_BASE: 'minimal'\n"
+        "CONFIG_NAME: 'devnet-7'\n"
+        "SECONDS_PER_SLOT: 3\n"
+        "GENESIS_FORK_VERSION: 0x10000001\n"
+        "ALTAIR_FORK_VERSION: 0x10000002\n"
+        "ALTAIR_FORK_EPOCH: 1\n")
+    spec = load_testnet_dir(str(tmp_path))
+    assert spec.config_name == "devnet-7"
+    assert spec.seconds_per_slot == 3
+    assert spec.preset.name == "minimal"
+    assert spec.altair_fork_epoch == 1
+    # a chain actually boots on the custom network
+    from lighthouse_tpu.crypto import bls
+    bls.set_backend("fake")
+    from lighthouse_tpu.chain import BeaconChainHarness
+    h = BeaconChainHarness(spec, 16)
+    h.extend_chain(2)
+    assert h.chain.head().head_state.slot == 2
+
+
+def test_watch_http_server_and_metrics_timers():
+    """Watch HTTP server routes + hot-path metric timers."""
+    import json
+    import urllib.request
+    from lighthouse_tpu.api import metrics
+    from lighthouse_tpu.crypto import bls
+    bls.set_backend("fake")
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, 32)
+    h.extend_chain(spec.preset.slots_per_epoch)
+    mon = WatchMonitor(h.chain)
+    srv = __import__("lighthouse_tpu.watch.monitor",
+                     fromlist=["WatchServer"]).WatchServer(mon)
+    srv.start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}") as r:
+                return json.loads(r.read())
+        rows = get("/v1/blocks?start=1&end=8")["data"]
+        assert rows and rows[0]["slot"] >= 1
+        one = get(f"/v1/blocks/{rows[0]['slot']}")["data"]
+        assert one["slot"] == rows[0]["slot"]
+        top = get("/v1/validators/proposers")["data"]
+        assert top and top[0]["blocks"] >= 1
+        missed = get("/v1/slots/missed?start=1&end=8")["data"]
+        assert missed == []
+    finally:
+        srv.stop()
+    # hot-path timers recorded through the live metrics module
+    from lighthouse_tpu.crypto.bls import SignatureSet
+    b = bls.get_backend()
+    bls.verify_signature_sets([SignatureSet(b"\x00" * 96, [b"\x00" * 48],
+                                            b"m")])
+    from prometheus_client import generate_latest
+    text = generate_latest(metrics.REGISTRY).decode()
+    assert "bls_batch_verify_seconds" in text
+    assert "validator_registry_tree_hash_seconds" in text
+    with metrics.timer("unit_test_timer_seconds"):
+        pass
+    text = generate_latest(metrics.REGISTRY).decode()
+    assert "unit_test_timer_seconds" in text
+
+
+def test_testnet_dir_genesis_state_is_loaded(tmp_path):
+    """--testnet-dir genesis.ssz must become THE genesis state (review r2:
+    ignoring it forks the node off its own network)."""
+    from lighthouse_tpu.crypto import bls
+    bls.set_backend("fake")
+    from lighthouse_tpu.specs.networks import (
+        load_testnet_dir, testnet_genesis_state,
+    )
+    from lighthouse_tpu.state_transition import interop_genesis_state
+    (tmp_path / "config.yaml").write_text(
+        "PRESET_BASE: 'minimal'\nCONFIG_NAME: 'devnet-g'\n")
+    spec = load_testnet_dir(str(tmp_path))
+    real = interop_genesis_state(
+        spec, [bls.keygen_interop(i) for i in range(16)], genesis_time=7)
+    (tmp_path / "genesis.ssz").write_bytes(real.serialize())
+    loaded = testnet_genesis_state(str(tmp_path), spec)
+    assert loaded is not None
+    assert loaded.hash_tree_root() == real.hash_tree_root()
+    # and it threads through the client config into the chain
+    from lighthouse_tpu.client.builder import ClientBuilder, ClientConfig
+    cfg = ClientConfig(genesis_state=loaded, http_enabled=False)
+    client = ClientBuilder(spec).with_config(cfg).build()
+    try:
+        assert client.chain.genesis_state.hash_tree_root() == \
+            real.hash_tree_root()
+    finally:
+        client.stop() if hasattr(client, "stop") else None
