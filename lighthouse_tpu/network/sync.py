@@ -30,45 +30,82 @@ class SyncManager:
 
     # -- range sync ----------------------------------------------------------
 
-    def maybe_sync(self) -> int:
-        """If a peer is ahead, range-sync toward its head. Returns blocks
-        imported."""
-        peer_info = self.peers.best_peer_for_sync()
-        if peer_info is None or peer_info.status is None:
-            return 0
-        local_head = self.chain.head().head_state.slot
-        remote_head = peer_info.status.head_slot
-        if remote_head <= local_head:
-            self.state = "synced"
-            return 0
+    MAX_INFLIGHT_BATCHES = 4    # parallel peer-pool downloads
+
+    def _sync_peer_pool(self, min_head: int) -> list:
+        """Non-banned, non-negative-score peers whose head is past
+        min_head (range_sync/range.rs peer pool)."""
+        return [p for p in self.peers.connected()
+                if p.status is not None and p.status.head_slot > min_head
+                and p.score >= 0]
+
+    def _download_batch(self, peer_info, start: int, count: int):
         peer = self.rpc.transport.peers.get(peer_info.node_id)
         if peer is None:
+            raise TimeoutError("peer gone")
+        resp = self.rpc.request(peer, "beacon_blocks_by_range",
+                                {"start_slot": start, "count": count})
+        blocks = [self._decode_block(b) for b in resp or []]
+        return [b for b in blocks if b is not None]
+
+    def maybe_sync(self) -> int:
+        """If peers are ahead, range-sync toward the best head with
+        batches downloaded in PARALLEL from the peer pool and imported in
+        order (range_sync/range.rs:27-40 batch pipelining; round 1 pulled
+        sequentially from a single peer)."""
+        local_head = self.chain.head().head_state.slot
+        pool = self._sync_peer_pool(local_head)
+        if not pool:
+            self.state = "synced"
             return 0
+        remote_head = max(p.status.head_slot for p in pool)
         self.state = "range_syncing"
         spe = self.chain.spec.preset.slots_per_epoch
         batch_slots = EPOCHS_PER_BATCH * spe
-        imported = 0
+        spans = []
         start = local_head + 1
         while start <= remote_head:
             count = min(batch_slots, remote_head - start + 1)
-            try:
-                resp = self.rpc.request(peer, "beacon_blocks_by_range",
-                                        {"start_slot": start,
-                                         "count": count})
-            except (TimeoutError, RuntimeError):
-                self.peers.report(peer_info.node_id, "timeout")
-                break
-            blocks = [self._decode_block(b) for b in resp or []]
-            blocks = [b for b in blocks if b is not None]
-            if blocks:
-                try:
-                    imported += self.chain.process_chain_segment(blocks)
-                except BlockError:
-                    self.peers.report(peer_info.node_id, "bad_segment")
-                    break
-            # empty batches are legitimate (runs of skipped slots): keep
-            # advancing toward the remote head
+            spans.append((start, count))
             start += count
+        imported = 0
+        from concurrent.futures import ThreadPoolExecutor
+        workers = min(self.MAX_INFLIGHT_BATCHES, len(pool), len(spans))
+        with ThreadPoolExecutor(max_workers=max(1, workers)) as pool_ex:
+            futures = {}
+            for i, (s, c) in enumerate(spans):
+                # batches must cover slots the chosen peer actually has
+                eligible = [p for p in pool
+                            if p.status.head_slot >= s] or pool
+                peer_info = eligible[i % len(eligible)]
+                futures[i] = (peer_info,
+                              pool_ex.submit(self._download_batch,
+                                             peer_info, s, c))
+            for i in range(len(spans)):
+                peer_info, fut = futures[i]
+                try:
+                    blocks = fut.result(timeout=20)
+                except Exception:
+                    self.peers.report(peer_info.node_id, "timeout")
+                    # one in-order retry from a different peer
+                    others = [p for p in pool
+                              if p.node_id != peer_info.node_id]
+                    if not others:
+                        break
+                    retry = others[i % len(others)]
+                    try:
+                        blocks = self._download_batch(retry, *spans[i])
+                        peer_info = retry
+                    except Exception:
+                        self.peers.report(retry.node_id, "timeout")
+                        break
+                if blocks:
+                    try:
+                        imported += self.chain.process_chain_segment(blocks)
+                    except BlockError:
+                        self.peers.report(peer_info.node_id, "bad_segment")
+                        break
+                # empty batches are legitimate (runs of skipped slots)
         self.state = "synced"
         return imported
 

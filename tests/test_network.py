@@ -111,3 +111,43 @@ def test_rpc_blocks_by_root():
     finally:
         na.stop()
         nb.stop()
+
+
+def test_range_sync_downloads_from_peer_pool():
+    """Range sync pipelines batches across MULTIPLE peers
+    (range_sync/range.rs:27-40), not one sequential peer."""
+    spec = minimal_spec()
+    src = BeaconChainHarness(spec, 64)
+    src.extend_chain(6 * spec.preset.slots_per_epoch)  # 6 batches of work
+    providers = []
+    counts = []
+    for _ in range(3):
+        svc = NetworkService(src.chain)
+        n = []
+        orig = svc._blocks_by_range
+        svc.rpc.register("beacon_blocks_by_range",
+                         (lambda orig, n: lambda peer, p:
+                          (n.append(p["start_slot"]), orig(peer, p))[1])(
+                              orig, n))
+        providers.append(svc)
+        counts.append(n)
+    follower_chain = BeaconChainHarness(spec, 64).chain
+    nb = NetworkService(follower_chain)
+    for svc in providers:
+        svc.start()
+    nb.start()
+    try:
+        follower_chain.slot_clock.set_slot(src.chain.slot())
+        for svc in providers:
+            nb.dial("127.0.0.1", svc.port)
+        assert _wait(lambda: len(nb.sync._sync_peer_pool(0)) == 3, 10)
+        imported = nb.sync.maybe_sync()
+        assert imported >= 6 * spec.preset.slots_per_epoch - 2
+        assert follower_chain.head().head_block_root == \
+            src.chain.head().head_block_root
+        served = [len(n) for n in counts]
+        assert sum(1 for s in served if s > 0) >= 2, served  # >=2 peers used
+    finally:
+        nb.stop()
+        for svc in providers:
+            svc.stop()
