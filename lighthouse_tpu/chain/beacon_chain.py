@@ -119,6 +119,8 @@ class BeaconChain:
         self.observed_aggregates = ObservedAggregates()
         self.observed_sync_contributors = ObservedAttesters()
         self.observed_blob_sidecars = ObservedBlobSidecars()
+        self.observed_data_columns = ObservedBlobSidecars()
+        self.data_columns: OrderedDict[bytes, dict] = OrderedDict()
         self._verified_sidecar_headers: OrderedDict[bytes, bool] = \
             OrderedDict()
         self.observed_operations = ObservedOperations()
@@ -303,6 +305,27 @@ class BeaconChain:
         if ready is not None:
             return self.import_block(ready)
         return None
+
+    def process_data_column_sidecar(self, sidecar) -> None:
+        """PeerDAS gossip intake (data_column_verification.rs): structure
+        + inclusion proof + header signature BEFORE observing, same
+        discipline as blob sidecars."""
+        from .data_columns import verify_data_column_sidecar
+        hdr = sidecar.signed_block_header.message
+        block_root = htr(hdr)
+        if self.observed_data_columns.has_been_observed(
+                hdr.slot, hdr.proposer_index, sidecar.index):
+            return
+        if not verify_data_column_sidecar(self.T, sidecar):
+            raise BlockError(INVALID_BLOCK, "bad data column sidecar")
+        self._verify_sidecar_header(sidecar, block_root)
+        self.observed_data_columns.observe(hdr.slot, hdr.proposer_index,
+                                           sidecar.index)
+        cols = self.data_columns.setdefault(block_root, {})
+        cols[int(sidecar.index)] = sidecar
+        self.data_columns.move_to_end(block_root)
+        while len(self.data_columns) > 16:
+            self.data_columns.popitem(last=False)
 
     def _verify_sidecar_header(self, sidecar, block_root: bytes) -> None:
         """Proposer-index + header-signature gossip checks for a blob
@@ -539,6 +562,7 @@ class BeaconChain:
         fin_slot = fin_epoch * p.slots_per_epoch
         self.observed_block_producers.prune(fin_slot)
         self.observed_blob_sidecars.prune(fin_slot)
+        self.observed_data_columns.prune(fin_slot)
         self.observed_slashable.prune(fin_slot)
         self.observed_attesters.prune(fin_epoch - 1)
         self.observed_aggregators.prune(fin_slot)

@@ -472,6 +472,31 @@ def _build_types(p: Preset) -> Types:
         block_root: Root
         index: uint64
 
+    # -- PeerDAS data columns (fulu; types/src/data_column_sidecar.rs) -------
+    # A cell is one column-slice of a blob: field_elements_per_blob /
+    # NUMBER_OF_COLUMNS field elements (no RS extension in this miniature —
+    # documented in chain/data_columns.py).
+    from ..specs.constants import (
+        KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH, NUMBER_OF_COLUMNS,
+    )
+    Cell = ByteVector(32 * p.field_elements_per_blob
+                      // NUMBER_OF_COLUMNS)
+
+    @container
+    class DataColumnSidecar:
+        index: uint64
+        column: List(Cell, p.max_blob_commitments_per_block)
+        kzg_commitments: List(Bytes48, p.max_blob_commitments_per_block)
+        kzg_proofs: List(Bytes48, p.max_blob_commitments_per_block)
+        signed_block_header: SignedBeaconBlockHeader.ssz_type
+        kzg_commitments_inclusion_proof: Vector(
+            Bytes32, KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH)
+
+    @container
+    class DataColumnIdentifier:
+        block_root: Root
+        index: uint64
+
     # -- light client (subset; full protocol in api/light_client) ------------
     @container
     class LightClientHeader:

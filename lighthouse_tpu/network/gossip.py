@@ -49,6 +49,10 @@ class Topic:
     def blob_sidecar(index: int) -> str:
         return f"blob_sidecar_{index}"
 
+    @staticmethod
+    def data_column_subnet(subnet: int) -> str:
+        return f"data_column_sidecar_{subnet}"
+
 
 MSG_DATA, MSG_SUB, MSG_UNSUB, MSG_GRAFT, MSG_PRUNE, MSG_IHAVE, MSG_IWANT = \
     range(7)

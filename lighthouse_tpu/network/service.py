@@ -72,6 +72,15 @@ class NetworkService:
             self.gossip.subscribe(Topic.attestation_subnet(subnet))
         for subnet in range(4):
             self.gossip.subscribe(Topic.sync_subnet(subnet))
+        # PeerDAS custody subnets derived from our authenticated node id
+        from ..chain.data_columns import (
+            compute_subnet_for_column, get_custody_columns,
+        )
+        self.custody_columns = get_custody_columns(
+            bytes.fromhex(self.transport.node_id))
+        for subnet in sorted({compute_subnet_for_column(c)
+                              for c in self.custody_columns}):
+            self.gossip.subscribe(Topic.data_column_subnet(subnet))
 
         self.rpc.register("status", self._handle_status)
         self.rpc.register("ping", lambda peer, p: {"seq": 1})
@@ -212,6 +221,10 @@ class NetworkService:
                     chain.T.SignedAggregateAndProof.ssz_type, data)
                 v = chain.verify_aggregated_attestation_for_gossip(agg)
                 return "accept", v
+            if topic.startswith("data_column_sidecar_"):
+                sc = deserialize(chain.T.DataColumnSidecar.ssz_type, data)
+                chain.process_data_column_sidecar(sc)
+                return "accept", sc
             if topic.startswith("sync_committee_"):
                 msg = deserialize(chain.T.SyncCommitteeMessage.ssz_type,
                                   data)
@@ -223,7 +236,8 @@ class NetworkService:
                 return "ignore", None
             return ("reject" if e.kind in ("repeat_proposal",
                                            "invalid_signature",
-                                           "incorrect_proposer")
+                                           "incorrect_proposer",
+                                           "invalid_block")
                     else "ignore"), None
         except AttestationError as e:
             return ("ignore" if e.kind in ("prior_attestation_known",

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 
-from ..chain.errors import BlockError
+from ..chain.errors import PARENT_UNKNOWN, BlockError
 from ..ssz import deserialize, htr, serialize
 
 EPOCHS_PER_BATCH = 2
@@ -71,7 +71,9 @@ class SyncManager:
         imported = 0
         from concurrent.futures import ThreadPoolExecutor
         workers = min(self.MAX_INFLIGHT_BATCHES, len(pool), len(spans))
-        with ThreadPoolExecutor(max_workers=max(1, workers)) as pool_ex:
+        pool_ex = ThreadPoolExecutor(max_workers=max(1, workers))
+        prev_peer = None            # served the batch BEFORE this one
+        try:
             futures = {}
             for i, (s, c) in enumerate(spans):
                 # batches must cover slots the chosen peer actually has
@@ -102,10 +104,20 @@ class SyncManager:
                 if blocks:
                     try:
                         imported += self.chain.process_chain_segment(blocks)
-                    except BlockError:
-                        self.peers.report(peer_info.node_id, "bad_segment")
+                    except BlockError as e:
+                        if e.kind == PARENT_UNKNOWN and prev_peer is not None:
+                            # likely the EARLIER batch was short/empty —
+                            # don't ban this (possibly honest) peer for it
+                            self.peers.report(prev_peer.node_id, "ignore")
+                        else:
+                            self.peers.report(peer_info.node_id,
+                                              "bad_segment")
                         break
                 # empty batches are legitimate (runs of skipped slots)
+                prev_peer = peer_info
+        finally:
+            # a break must not wait for queued downloads to run to completion
+            pool_ex.shutdown(wait=False, cancel_futures=True)
         self.state = "synced"
         return imported
 
