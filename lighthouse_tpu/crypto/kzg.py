@@ -69,27 +69,70 @@ class Kzg:
         out += [0] * (self.size - n)
         return out
 
+    def _root(self) -> int:
+        """Primitive root of order self.size (the domain subgroup)."""
+        return pow(_ROOT_OF_UNITY, FIELD_ELEMENTS_PER_BLOB // self.size, R)
+
+    def _ntt(self, vals: list[int], invert: bool) -> list[int]:
+        """Iterative radix-2 NTT over standard order (O(n log n) — the
+        round-1 O(n^2) Lagrange interpolation is gone)."""
+        n = len(vals)
+        a = list(vals)
+        # bit-reversal permutation to start the butterflies
+        j = 0
+        for i in range(1, n):
+            bit = n >> 1
+            while j & bit:
+                j ^= bit
+                bit >>= 1
+            j |= bit
+            if i < j:
+                a[i], a[j] = a[j], a[i]
+        root = self._root()
+        if invert:
+            root = pow(root, R - 2, R)
+        length = 2
+        while length <= n:
+            wlen = pow(root, n // length, R)
+            for i in range(0, n, length):
+                w = 1
+                half = length // 2
+                for k in range(i, i + half):
+                    u, v = a[k], a[k + half] * w % R
+                    a[k] = (u + v) % R
+                    a[k + half] = (u - v) % R
+                    w = w * wlen % R
+            length <<= 1
+        if invert:
+            ninv = pow(n, R - 2, R)
+            a = [x * ninv % R for x in a]
+        return a
+
     def _coeffs(self, evals: list[int]) -> list[int]:
-        """Lagrange interpolation over the domain (O(n^2) reference path;
-        the batched TPU NTT is the planned fast path)."""
+        """Monomial coefficients from evaluations over the bit-reversed
+        domain: un-permute (brp is an involution) then inverse NTT."""
         n = self.size
-        coeffs = [0] * n
-        for j, (xj, yj) in enumerate(zip(self.domain, evals)):
-            if yj == 0:
-                continue
-            # basis polynomial l_j via incremental products
-            num = [1]
-            denom = 1
-            for m, xm in enumerate(self.domain):
-                if m == j:
-                    continue
-                num = _poly_mul_linear(num, (-xm) % R)
-                denom = denom * ((xj - xm) % R) % R
-            dinv = pow(denom, R - 2, R)
-            scale = yj * dinv % R
-            for k, c in enumerate(num):
-                coeffs[k] = (coeffs[k] + c * scale) % R
-        return coeffs
+        std = [0] * n
+        for i, v in enumerate(evals):
+            std[_brp(i, n)] = v
+        return self._ntt(std, invert=True)
+
+    def _eval_barycentric(self, evals: list[int], z: int) -> int:
+        """p(z) from evaluation form without interpolation (the spec's
+        evaluate_polynomial_in_evaluation_form):
+        p(z) = (z^n - 1)/n * sum_i evals_i * d_i / (z - d_i)."""
+        n = self.size
+        for i, d in enumerate(self.domain):
+            if d == z % R:
+                return evals[i]
+        diffs = [(z - d) % R for d in self.domain]
+        invs = _batch_inverse(diffs)
+        acc = 0
+        for e, d, inv in zip(evals, self.domain, invs):
+            if e:
+                acc = (acc + e * d % R * inv) % R
+        zn = (pow(z, n, R) - 1) % R
+        return acc * zn % R * pow(n, R - 2, R) % R
 
     def _commit_coeffs(self, coeffs: list[int]) -> Point:
         acc = Point.infinity(B_G1)
@@ -135,15 +178,53 @@ class Kzg:
     def verify_blob_kzg_proof(self, blob: bytes, commitment: bytes,
                               proof: bytes) -> bool:
         z = _challenge(blob, commitment)
-        coeffs = self._coeffs(self._evals_from_blob(blob))
-        y = _poly_eval(coeffs, z)
+        y = self._eval_barycentric(self._evals_from_blob(blob), z)
         return self.verify_kzg_proof(commitment, z, y, proof)
 
     def verify_blob_kzg_proof_batch(self, blobs: list[bytes],
                                     commitments: list[bytes],
                                     proofs: list[bytes]) -> bool:
-        return all(self.verify_blob_kzg_proof(b, c, p)
-                   for b, c, p in zip(blobs, commitments, proofs))
+        """ONE 2-pairing check for the whole batch via a random linear
+        combination (c-kzg verify_blob_kzg_proof_batch):
+          e(sum r_i pi_i, [tau]_2) * e(-sum r_i (C_i - y_i G + z_i pi_i),
+            g_2) == 1
+        The deneb 6-blob sidecar batch costs the same two pairings as one
+        blob (round 1 paid n pairing-pairs)."""
+        import secrets
+        if not (len(blobs) == len(commitments) == len(proofs)):
+            return False
+        if not blobs:
+            return True
+        agg_proof = Point.infinity(B_G1)
+        agg_rest = Point.infinity(B_G1)
+        for blob, comm, prf in zip(blobs, commitments, proofs):
+            c = g1_decompress(comm)
+            w = g1_decompress(prf)
+            if c is None or w is None:
+                return False
+            z = _challenge(blob, comm)
+            y = self._eval_barycentric(self._evals_from_blob(blob), z)
+            r = 1 if len(blobs) == 1 else secrets.randbits(128) | 1
+            agg_proof = agg_proof.add(w.mul(r))
+            rest = c.add(G1_GENERATOR.mul(y).neg()).add(w.mul(z))
+            agg_rest = agg_rest.add(rest.mul(r))
+        return multi_pairing([
+            (agg_proof, self.tau_g2),
+            (agg_rest.neg(), G2_GENERATOR),
+        ]).is_one()
+
+
+def _batch_inverse(vals: list[int]) -> list[int]:
+    """Montgomery batch inversion: one field inversion for the lot."""
+    prefix = [1] * (len(vals) + 1)
+    for i, v in enumerate(vals):
+        prefix[i + 1] = prefix[i] * v % R
+    inv = pow(prefix[-1], R - 2, R)
+    out = [0] * len(vals)
+    for i in range(len(vals) - 1, -1, -1):
+        out[i] = prefix[i] * inv % R
+        inv = inv * vals[i] % R
+    return out
 
 
 def _brp(i: int, n: int) -> int:
