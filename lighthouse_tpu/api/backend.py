@@ -577,12 +577,10 @@ class ApiBackend:
         return {"attested_slot": str(u.attested_header.beacon.slot)}
 
     def light_client_updates(self, start_period: int, count: int) -> list:
-        out = []
-        head_root = self.chain.head().head_block_root
-        u = self.chain.light_client_cache.produce_update(head_root)
-        if u is not None:
-            out.append({"attested_slot": str(u.attested_header.beacon.slot)})
-        return out[:count]
+        ups = self.chain.light_client_cache.updates_by_range(start_period,
+                                                            count)
+        return [{"attested_slot": str(u.attested_header.beacon.slot),
+                 "signature_slot": str(u.signature_slot)} for u in ups]
 
     # -- config --------------------------------------------------------------
 

@@ -115,10 +115,15 @@ def _header_for(state: BeaconState) -> LightClientHeader:
 class LightClientServerCache:
     """Tracks the best updates as blocks are imported (altair+ only)."""
 
+    MAX_STORED_PERIODS = 128    # light_client_server update-range cap
+
     def __init__(self, chain):
         self.chain = chain
         self.latest_finality_update: LightClientFinalityUpdate | None = None
         self.latest_optimistic_update: LightClientOptimisticUpdate | None = None
+        # best update per sync-committee period (update-range serving)
+        self.best_updates: dict[int, LightClientUpdate] = {}
+        self._best_participation: dict[int, int] = {}
 
     def produce_bootstrap(self, block_root: bytes
                           ) -> LightClientBootstrap | None:
@@ -167,6 +172,30 @@ class LightClientServerCache:
                 finalized_header=LightClientHeader(beacon=fin_hdr),
                 finality_branch=branch, sync_aggregate=agg,
                 signature_slot=signed_block.message.slot)
+        # keep the BEST (most-participating) update per sync period
+        # (light_client_server best_update tracking)
+        p = self.chain.spec.preset
+        period = attested_state.slot // (
+            p.slots_per_epoch * p.epochs_per_sync_committee_period)
+        if participants > self._best_participation.get(period, 0):
+            update = self.produce_update(signed_block.message.parent_root)
+            if update is not None:
+                self.best_updates[period] = update
+                self._best_participation[period] = participants
+                while len(self.best_updates) > self.MAX_STORED_PERIODS:
+                    oldest = min(self.best_updates)
+                    self.best_updates.pop(oldest, None)
+                    self._best_participation.pop(oldest, None)
+
+    def updates_by_range(self, start_period: int,
+                         count: int) -> list[LightClientUpdate]:
+        """GET /eth/v1/beacon/light_client/updates serving."""
+        out = []
+        for period in range(start_period, start_period + min(count, 128)):
+            u = self.best_updates.get(period)
+            if u is not None:
+                out.append(u)
+        return out
 
     def produce_update(self, block_root: bytes) -> LightClientUpdate | None:
         """Sync-committee-period update for the given attested block."""
