@@ -229,8 +229,8 @@ def main():
     # platform — cold-cache compiles are budgeted into the 900 s — then
     # straight to the forced-CPU fallback (a wedged TPU tunnel hangs, it
     # doesn't error, so retrying the same config only delays the JSON).
-    budget = [(False, int(os.environ.get("LHTPU_BENCH_TPU_TIMEOUT", 900))),
-              (True, int(os.environ.get("LHTPU_BENCH_CPU_TIMEOUT", 1200)))]
+    budget = [(False, int(os.environ.get("LHTPU_BENCH_TPU_TIMEOUT", 720))),
+              (True, int(os.environ.get("LHTPU_BENCH_CPU_TIMEOUT", 1500)))]
     if os.environ.get("LHTPU_BENCH_FORCE_CPU"):
         budget = [budget[-1]]
     for force_cpu, timeout in budget:
