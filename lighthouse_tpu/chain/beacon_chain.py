@@ -310,7 +310,9 @@ class BeaconChain:
         """PeerDAS gossip intake (data_column_verification.rs): structure
         + inclusion proof + header signature BEFORE observing, same
         discipline as blob sidecars."""
-        from .data_columns import verify_data_column_sidecar
+        from .data_columns import (
+            verify_data_column_sidecar, verify_data_column_sidecar_kzg,
+        )
         hdr = sidecar.signed_block_header.message
         block_root = htr(hdr)
         if self.observed_data_columns.has_been_observed(
@@ -319,6 +321,11 @@ class BeaconChain:
         if not verify_data_column_sidecar(self.T, sidecar):
             raise BlockError(INVALID_BLOCK, "bad data column sidecar")
         self._verify_sidecar_header(sidecar, block_root)
+        # KZG cell proofs last: cheap structural + signature checks first
+        # (DoS ordering, data_column_verification.rs)
+        if not verify_data_column_sidecar_kzg(
+                self.T, sidecar, self.data_availability_checker.kzg):
+            raise BlockError(INVALID_BLOCK, "bad data column cell proofs")
         self.observed_data_columns.observe(hdr.slot, hdr.proposer_index,
                                            sidecar.index)
         cols = self.data_columns.setdefault(block_root, {})

@@ -29,6 +29,20 @@ class FakeKzgVerifier:
         import hashlib
         return bytes([0x80]) + hashlib.sha256(blob).digest() + b"\x00" * 15
 
+    # PeerDAS cells surface: a systematic "extension" (blob then zeros)
+    # with fake proofs, mirroring the real layout where the first half of
+    # the cells is the blob itself.  No erasure recovery (fake crypto).
+    def compute_cells_and_kzg_proofs(self, blob):
+        from ..specs.constants import NUMBER_OF_COLUMNS
+        ext = bytes(blob) + b"\x00" * len(blob)
+        cs = len(ext) // NUMBER_OF_COLUMNS
+        cells = [ext[j * cs:(j + 1) * cs] for j in range(NUMBER_OF_COLUMNS)]
+        return cells, [b"\xfa" * 48] * NUMBER_OF_COLUMNS
+
+    def verify_cell_kzg_proof_batch(self, commitments, cell_indices, cells,
+                                    proofs):
+        return True
+
 
 # ---------------------------------------------------------------------------
 # commitment inclusion proofs (BlobSidecar.kzg_commitment_inclusion_proof)

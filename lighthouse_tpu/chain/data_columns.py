@@ -1,18 +1,17 @@
 """PeerDAS data-column sidecars (fulu machinery).
 
 Equivalent of consensus/types/src/data_column_sidecar.rs,
-data_column_subnet_id.rs, and beacon_chain/src/data_column_verification.rs
-in miniature: column construction from blobs, the commitments-list
-inclusion proof, subnet mapping, spec custody assignment, and gossip
-verification (header signature via the chain's sidecar path + proof +
-shape checks).
+data_column_subnet_id.rs, and beacon_chain/src/data_column_verification.rs:
+column construction from the Reed-Solomon-extended blobs (crypto/kzg.py
+`compute_cells_and_kzg_proofs`), per-cell KZG proofs, the commitments-list
+inclusion proof, subnet mapping, spec custody assignment, gossip
+verification (header signature via the chain's sidecar path + cell-proof
+batch + shape checks), and blob reconstruction from any 50% of columns
+(`recover_cells_and_kzg_proofs`).
 
-Documented deviation: cells are plain blob slices with NO Reed-Solomon
-extension and no per-cell KZG proofs (a cells-KZG setup is not bundled);
-`kzg_proofs` carries the per-blob proof for each row.  Consequently
-reconstruction needs ALL columns rather than any half.  The wiring —
-types, subnets, custody, verification order, observed-cache discipline —
-matches the reference.
+The first NUMBER_OF_COLUMNS/2 cells of the extension are the blob itself
+(systematic RS code), so reconstruction needs either the full systematic
+half or, with a real KZG, any half of the columns.
 """
 from __future__ import annotations
 
@@ -30,15 +29,29 @@ from .data_availability import (
 
 
 def cell_size(T) -> int:
-    return 32 * T.preset.field_elements_per_blob // NUMBER_OF_COLUMNS
+    """Bytes per cell of the 2x-extended blob (spec BYTES_PER_CELL)."""
+    return 64 * T.preset.field_elements_per_blob // NUMBER_OF_COLUMNS
 
 
-def blobs_to_columns(T, blobs: list[bytes]) -> list[list[bytes]]:
-    """Column j = [cell_j(blob_i) for each blob i] (row-major blobs ->
-    column-major cells)."""
-    cs = cell_size(T)
-    return [[bytes(blob[j * cs:(j + 1) * cs]) for blob in blobs]
+def blobs_to_columns(
+        T, blobs: list[bytes], kzg
+) -> tuple[list[list[bytes]], list[list[bytes]]]:
+    """Column j = [cell_j(extended blob_i) for each blob i] (row-major
+    blobs -> column-major cells).  Returns (columns, proof_columns)."""
+    cells_rows, proof_rows = [], []
+    for blob in blobs:
+        cells, proofs = kzg.compute_cells_and_kzg_proofs(bytes(blob))
+        if len(cells) != NUMBER_OF_COLUMNS:
+            raise ValueError(
+                f"KZG setup produces {len(cells)} cells per extended "
+                f"blob; the sidecar machinery needs {NUMBER_OF_COLUMNS}")
+        cells_rows.append(cells)
+        proof_rows.append(proofs)
+    cols = [[cells_rows[b][j] for b in range(len(blobs))]
             for j in range(NUMBER_OF_COLUMNS)]
+    proof_cols = [[proof_rows[b][j] for b in range(len(blobs))]
+                  for j in range(NUMBER_OF_COLUMNS)]
+    return cols, proof_cols
 
 
 def commitments_list_proof(T, body) -> list[bytes]:
@@ -82,13 +95,11 @@ def produce_data_column_sidecars(T, signed_block, blobs: list[bytes],
             body_root=htr(body)),
         signature=signed_block.signature)
     commitments = list(body.blob_kzg_commitments)
-    proofs = [kzg.compute_blob_kzg_proof(b, c)
-              for b, c in zip(blobs, commitments)]
     proof = commitments_list_proof(T, body)
-    columns = blobs_to_columns(T, blobs)
+    columns, proof_cols = blobs_to_columns(T, blobs, kzg)
     return [T.DataColumnSidecar(
         index=j, column=columns[j], kzg_commitments=commitments,
-        kzg_proofs=proofs, signed_block_header=header,
+        kzg_proofs=proof_cols[j], signed_block_header=header,
         kzg_commitments_inclusion_proof=proof)
         for j in range(NUMBER_OF_COLUMNS)]
 
@@ -131,17 +142,49 @@ def get_custody_columns(node_id: bytes,
                   if compute_subnet_for_column(c) in subnets)
 
 
-def reconstruct_blobs(T, sidecars: list) -> list[bytes]:
-    """Rebuild the blobs from a full column set (no RS extension in this
-    miniature, so all NUMBER_OF_COLUMNS are required)."""
+def verify_data_column_sidecar_kzg(T, sidecar, kzg) -> bool:
+    """Batch cell-proof check for every row of the column
+    (data_column_verification.rs verify_kzg_for_data_column)."""
+    n = len(sidecar.column)
+    try:
+        return kzg.verify_cell_kzg_proof_batch(
+            [bytes(c) for c in sidecar.kzg_commitments],
+            [int(sidecar.index)] * n,
+            [bytes(c) for c in sidecar.column],
+            [bytes(p) for p in sidecar.kzg_proofs])
+    except Exception:
+        return False   # e.g. a setup without cell support: fail closed
+
+
+def reconstruct_blobs(T, sidecars: list, kzg=None) -> list[bytes]:
+    """Rebuild the blobs from columns.
+
+    The code is systematic: the first half of the columns IS the blob
+    data, so with all of columns [0, N/2) present no erasure decoding is
+    needed.  With a real KZG any >= 50% of columns recovers the rest
+    (spec recover_cells_and_kzg_proofs); without one (fake crypto), the
+    full systematic half is required.
+    """
     by_index = {int(s.index): s for s in sidecars}
-    if len(by_index) < NUMBER_OF_COLUMNS:
+    if not by_index:
+        raise ValueError("no columns")
+    half = NUMBER_OF_COLUMNS // 2
+    n_blobs = len(next(iter(by_index.values())).column)
+    if all(j in by_index for j in range(half)):
+        return [b"".join(bytes(by_index[j].column[i]) for j in range(half))
+                for i in range(n_blobs)]
+    if kzg is None or not hasattr(kzg, "recover_cells_and_kzg_proofs"):
+        missing = [j for j in range(half) if j not in by_index]
         raise ValueError(
-            f"need all {NUMBER_OF_COLUMNS} columns without erasure "
-            f"coding; have {len(by_index)}")
-    n_blobs = len(by_index[0].column)
+            f"systematic columns missing ({missing[:8]}...) and no "
+            f"erasure-capable KZG provided")
+    if len(by_index) < half:
+        raise ValueError(
+            f"need >= {half} columns to erasure-recover; have "
+            f"{len(by_index)}")
+    idxs = sorted(by_index)
     blobs = []
     for i in range(n_blobs):
-        blobs.append(b"".join(bytes(by_index[j].column[i])
-                              for j in range(NUMBER_OF_COLUMNS)))
+        cells = [bytes(by_index[j].column[i]) for j in idxs]
+        blobs.append(kzg.recover_blob(idxs, cells))
     return blobs

@@ -479,7 +479,8 @@ def _build_types(p: Preset) -> Types:
     from ..specs.constants import (
         KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH, NUMBER_OF_COLUMNS,
     )
-    Cell = ByteVector(32 * p.field_elements_per_blob
+    # cell of the 2x RS-extended blob (spec BYTES_PER_CELL)
+    Cell = ByteVector(64 * p.field_elements_per_blob
                       // NUMBER_OF_COLUMNS)
 
     @container

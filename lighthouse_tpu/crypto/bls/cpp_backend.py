@@ -56,6 +56,16 @@ def _load_lib():
     lib.bls_aggregate_pks.argtypes = [C.c_size_t, C.c_char_p, C.c_char_p]
     lib.bls_validate_pubkey.restype = C.c_int
     lib.bls_validate_pubkey.argtypes = [C.c_char_p]
+    try:  # KZG surface (crypto/kzg.py host acceleration)
+        lib.kzg_g1_msm.restype = C.c_int
+        lib.kzg_g1_msm.argtypes = [C.c_size_t, C.c_char_p, C.c_char_p,
+                                   C.c_char_p]
+        lib.kzg_pairing_check.restype = C.c_int
+        lib.kzg_pairing_check.argtypes = [C.c_size_t, C.c_char_p, C.c_char_p]
+        lib.kzg_g1_mul.restype = C.c_int
+        lib.kzg_g1_mul.argtypes = [C.c_char_p, C.c_char_p, C.c_char_p]
+    except AttributeError:
+        pass  # stale .so predating the KZG exports; kzg.py falls back
     rc = lib.bls_selftest()
     if rc != 0:
         raise RuntimeError(f"bls12_381 native selftest failed: {rc}")

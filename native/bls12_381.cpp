@@ -1209,4 +1209,59 @@ int bls_hash_to_g2_affine(const u8*msg,size_t msglen,const u8*dst,size_t dstlen,
     return 0;
 }
 
+// --- KZG host support (the c-kzg-equivalent half of SURVEY.md §2.6) --------
+// Generic G1 multi-scalar multiplication and multi-pairing check; the KZG
+// layer (crypto/kzg.py) reduces commit/verify to exactly these two calls.
+
+// out48 = sum_i scalars[i] * points[i].  scalars: 32-byte big-endian each;
+// points: compressed 48-byte each (infinity allowed).  rc 0 ok, 1 decode.
+int kzg_g1_msm(size_t n,const u8*scalars,const u8*points,u8*out48){
+    ensure_init();
+    G1 acc={FP_ONE_M,FP_ONE_M,FP_ZERO};
+    for(size_t i=0;i<n;i++){
+        G1 p; if(!g1_decompress(p,points+48*i)) return 1;
+        if(g1_is_inf(p)) continue;
+        // skip zero scalars (common: sparse polynomial coefficients)
+        u64 nz=0; for(int j=0;j<32;j++) nz|=scalars[32*i+j];
+        if(!nz) continue;
+        G1 t; g1_mul(t,p,scalars+32*i,32);
+        g1_add(acc,acc,t);
+    }
+    g1_compress(out48,acc);
+    return 0;
+}
+
+// prod_i e(P_i, Q_i) == 1 ?  P: compressed 48B each (subgroup-checked);
+// Q: compressed 96B each (subgroup-checked).  rc 1 yes, 0 no, -1 decode
+// or subgroup failure.
+int kzg_pairing_check(size_t n,const u8*g1s,const u8*g2s){
+    ensure_init();
+    std::vector<PairAff> ps;
+    for(size_t i=0;i<n;i++){
+        G1 p; G2 q;
+        if(!g1_decompress(p,g1s+48*i)) return -1;
+        if(!g2_decompress(q,g2s+96*i)) return -1;
+        if(!g1_is_inf(p)&&!g1_in_subgroup(p)) return -1;
+        if(!g2_is_inf(q)&&!g2_in_subgroup(q)) return -1;
+        if(g1_is_inf(p)||g2_is_inf(q)) continue;   // factor contributes 1
+        Fp ax,ay; Fp2 bx,by;
+        g1_to_affine(ax,ay,p); g2_to_affine(bx,by,q);
+        PairAff pr; pr.px=ax; pr.py=ay; pr.qx=bx; pr.qy=by;
+        pr.tx=bx; pr.ty=by; pr.inf=false;
+        ps.push_back(pr);
+    }
+    if(ps.empty()) return 1;
+    Fp12 f; multi_miller(f,ps);
+    return pairing_product_is_one(f)?1:0;
+}
+
+// single G1 scalar mul (setup generation helper): out = k * point.
+int kzg_g1_mul(const u8*scalar32,const u8*point48,u8*out48){
+    ensure_init();
+    G1 p; if(!g1_decompress(p,point48)) return 1;
+    G1 t; g1_mul(t,p,scalar32,32);
+    g1_compress(out48,t);
+    return 0;
+}
+
 } // extern "C"
