@@ -686,6 +686,43 @@ class ApiBackend:
     def debug_state_ssz(self, state_id: str) -> bytes:
         return self._resolve_state(state_id).serialize()
 
+    def expected_withdrawals(self, state_id: str) -> list[dict]:
+        """GET /eth/v1/builder/states/{id}/expected_withdrawals."""
+        from ..state_transition.block import get_expected_withdrawals
+        state = self._resolve_state(state_id)
+        if not hasattr(state, "next_withdrawal_index"):
+            raise ApiError(400, "pre-capella state has no withdrawals")
+        expected, _partials = get_expected_withdrawals(state)
+        return [{
+            "index": str(w.index),
+            "validator_index": str(w.validator_index),
+            "address": "0x" + bytes(w.address).hex(),
+            "amount": str(w.amount),
+        } for w in expected]
+
+    def validator_identities(self, state_id: str,
+                             ids: list[int] | None) -> list[dict]:
+        """GET /eth/v1/beacon/states/{id}/validator_identities."""
+        state = self._resolve_state(state_id)
+        n = len(state.validators)
+        idxs = range(n) if not ids else [i for i in ids if 0 <= i < n]
+        return [{
+            "index": str(i),
+            "pubkey": "0x" + state.validators.pubkey(i).hex(),
+            "activation_epoch": str(
+                int(state.validators.activation_epoch[i])),
+        } for i in idxs]
+
+    def publish_contribution_and_proofs(self, signed_list) -> None:
+        """POST /eth/v1/validator/contribution_and_proofs."""
+        from ..chain.errors import AttestationError
+        for signed in signed_list:
+            try:
+                self.chain.sync_committee_pool.verify_and_add_contribution(
+                    signed)
+            except AttestationError as e:
+                raise ApiError(400, f"contribution rejected: {e}")
+
     # -- validator extras ----------------------------------------------------
 
     def produce_block_ssz(self, slot: int, randao_reveal: bytes,

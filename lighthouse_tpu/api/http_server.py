@@ -68,6 +68,12 @@ class BeaconApiServer:
 # inventory; PARITY.md route count = GET table + this list + SSE/metrics)
 POST_ROUTES = [
     "/eth/v1/beacon/blocks",
+    "/eth/v2/beacon/blocks",
+    "/eth/v1/beacon/blinded_blocks",
+    "/eth/v2/beacon/blinded_blocks",
+    "/eth/v1/beacon/states/{state_id}/validators",
+    "/eth/v1/beacon/states/{state_id}/validator_balances",
+    "/eth/v1/validator/contribution_and_proofs",
     "/eth/v1/beacon/pool/attestations",
     "/eth/v1/beacon/pool/sync_committees",
     "/eth/v1/beacon/pool/attester_slashings",
@@ -271,6 +277,27 @@ def build_get_routes(backend: ApiBackend):
              backend.validators("head"))}}),
         (re.compile(r"^/lighthouse/ui/health$"),
          lambda m, q: {"data": {"healthy": backend.is_healthy()}}),
+        # -- full block retrieval (v2 serves raw SSZ via the do_GET
+        # special case; this is the legacy JSON alias) --
+        (re.compile(r"^/eth/v1/beacon/blocks/([^/]+)$"),
+         lambda m, q: {"data": {"ssz": backend.block_ssz(m[1]).hex()}}),
+        (re.compile(r"^/eth/v2/debug/beacon/heads$"),
+         lambda m, q: {"data": backend.debug_heads()}),
+        # -- builder/withdrawals + identities --
+        (re.compile(
+            r"^/eth/v1/builder/states/([^/]+)/expected_withdrawals$"),
+         lambda m, q: {"data": backend.expected_withdrawals(m[1])}),
+        (re.compile(
+            r"^/eth/v1/beacon/states/([^/]+)/validator_identities$"),
+         lambda m, q: {"data": backend.validator_identities(
+             m[1], [int(i) for i in q.get("id", [])] or None)}),
+        # (v2 validator block production is served as raw SSZ by the
+        # do_GET special case, alongside the v3 builder-aware entry)
+        # -- electra v2 pool views --
+        (re.compile(r"^/eth/v2/beacon/pool/attester_slashings$"),
+         lambda m, q: {"data": backend.pool_ops("attester_slashings")}),
+        (re.compile(r"^/eth/v2/beacon/pool/attestations$"),
+         lambda m, q: {"data": backend.pool_attestations()}),
     ]
 
 
@@ -451,6 +478,46 @@ def _make_handler(backend: ApiBackend):
                     duties = backend.get_sync_duties(int(m[1]), indices)
                     return self._json(200, {"data": [
                         {"validator_index": str(i)} for i in duties]})
+                if url.path in ("/eth/v2/beacon/blocks",
+                                "/eth/v1/beacon/blinded_blocks",
+                                "/eth/v2/beacon/blinded_blocks"):
+                    # v2: the broadcast_validation query levels all map to
+                    # our full consensus validation in process_block; the
+                    # blinded aliases accept the full block our VC posts
+                    # (unblinding happened client-side via the builder's
+                    # blinded_blocks endpoint, execution_layer/builder.py)
+                    fork = chain.spec.fork_name_at_slot(chain.slot())
+                    cls = chain.T.SignedBeaconBlock[fork]
+                    signed = deserialize(cls.ssz_type, body)
+                    backend.publish_block(signed)
+                    return self._json(200, {})
+                m = re.match(r"^/eth/v1/beacon/states/([^/]+)/validators$",
+                             url.path)
+                if m:
+                    req = json.loads(body or b"{}")
+                    ids = [int(i) for i in req.get("ids") or []]
+                    return self._json(200, {"data": backend.validators(
+                        m[1], ids or None)})
+                m = re.match(
+                    r"^/eth/v1/beacon/states/([^/]+)/validator_balances$",
+                    url.path)
+                if m:
+                    ids = [int(i) for i in json.loads(body or b"[]")]
+                    return self._json(200, {
+                        "data": backend.validator_balances(
+                            m[1], ids or None)})
+                if url.path == "/eth/v1/validator/contribution_and_proofs":
+                    # body = concatenated fixed-size
+                    # SignedContributionAndProof SSZ items
+                    from ..ssz import fixed_size
+                    t = chain.T.SignedContributionAndProof.ssz_type
+                    item = fixed_size(t)
+                    if item == 0 or len(body) % item:
+                        return self._json(400, {"message": "bad body size"})
+                    signed = [deserialize(t, body[i:i + item])
+                              for i in range(0, len(body), item)]
+                    backend.publish_contribution_and_proofs(signed)
+                    return self._json(200, {})
                 return self._json(404, {"message": "route not found"})
             except ApiError as e:
                 return self._json(e.status, {"message": str(e)})

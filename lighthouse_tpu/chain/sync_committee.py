@@ -24,6 +24,8 @@ class SyncCommitteePool:
         self._lock = threading.Lock()
         # (slot, root) -> {committee position -> signature}
         self._messages: dict[tuple, dict[int, bytes]] = defaultdict(dict)
+        # (slot, root, subcommittee) -> best verified contribution
+        self._contributions: dict[tuple, object] = {}
 
     def verify_and_add_message(self, msg) -> int:
         """Gossip path: verify a SyncCommitteeMessage and pool it. Returns
@@ -57,14 +59,104 @@ class SyncCommitteePool:
                 bucket[p] = msg.signature
         return len(positions)
 
+    def verify_and_add_contribution(self, signed) -> int:
+        """Gossip aggregate path (sync_committee_verification.rs
+        SignedContributionAndProof): selection proof, aggregator
+        signature, and the contribution's aggregate signature against the
+        subcommittee pubkeys, then pool the contribution for block
+        production.  Returns the number of set bits."""
+        from ..specs.constants import (
+            DOMAIN_CONTRIBUTION_AND_PROOF,
+            DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+            SYNC_COMMITTEE_SUBNET_COUNT,
+            TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+        )
+        from ..ssz import htr
+        from ..utils.hash import sha256
+        chain = self.chain
+        T = chain.T
+        msg = signed.message
+        contrib = msg.contribution
+        state = chain.head().head_state
+        epoch = contrib.slot // state.slots_per_epoch
+        if contrib.subcommittee_index >= SYNC_COMMITTEE_SUBNET_COUNT:
+            raise AttestationError("bad_subcommittee",
+                                   str(contrib.subcommittee_index))
+        committee = state.current_sync_committee
+        size = chain.spec.preset.sync_committee_size
+        sub_size = size // SYNC_COMMITTEE_SUBNET_COUNT
+        if msg.aggregator_index >= len(state.validators):
+            raise AttestationError("unknown_validator",
+                                   str(msg.aggregator_index))
+        agg_pk = state.validators.pubkey(msg.aggregator_index)
+        # 1. the aggregator is selected: selection proof valid + modulo
+        sel_data = T.SyncAggregatorSelectionData(
+            slot=contrib.slot,
+            subcommittee_index=contrib.subcommittee_index)
+        sel_domain = get_domain(state,
+                                DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+                                epoch)
+        sel_root = compute_signing_root(htr(sel_data), sel_domain)
+        if not bls.verify(agg_pk, sel_root, msg.selection_proof):
+            raise AttestationError(BAD_SIGNATURE, "selection proof")
+        modulo = max(1, sub_size // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE)
+        if int.from_bytes(sha256(bytes(msg.selection_proof))[:8],
+                          "little") % modulo != 0:
+            raise AttestationError("not_aggregator",
+                                   str(msg.aggregator_index))
+        # 2. aggregator signature over ContributionAndProof
+        cp_domain = get_domain(state, DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
+        cp_root = compute_signing_root(htr(msg), cp_domain)
+        if not bls.verify(agg_pk, cp_root, signed.signature):
+            raise AttestationError(BAD_SIGNATURE, "aggregator sig")
+        # 3. contribution aggregate signature by the set subcommittee keys
+        start = contrib.subcommittee_index * sub_size
+        pks = [bytes(committee.pubkeys[start + i])
+               for i, b in enumerate(contrib.aggregation_bits) if b]
+        if not pks:
+            raise AttestationError("empty_contribution", "no bits")
+        sc_domain = get_domain(state, DOMAIN_SYNC_COMMITTEE, epoch)
+        sc_root = compute_signing_root(contrib.beacon_block_root, sc_domain)
+        if not bls.fast_aggregate_verify(pks, sc_root, contrib.signature):
+            raise AttestationError(BAD_SIGNATURE, "contribution sig")
+        key = (int(contrib.slot), bytes(contrib.beacon_block_root),
+               int(contrib.subcommittee_index))
+        n_bits = sum(map(bool, contrib.aggregation_bits))
+        with self._lock:
+            cur = self._contributions.get(key)
+            if cur is None or sum(map(bool, cur.aggregation_bits)) < n_bits:
+                self._contributions[key] = contrib
+        return n_bits
+
     def produce_sync_aggregate(self, slot: int, beacon_block_root: bytes):
-        """Best SyncAggregate for a block at slot+1 (signed over `slot`)."""
+        """Best SyncAggregate for a block at slot+1 (signed over `slot`):
+        per subcommittee, the better of the pooled contribution and the
+        individually-pooled messages."""
+        from ..specs.constants import SYNC_COMMITTEE_SUBNET_COUNT
         T = self.chain.T
         size = self.chain.spec.preset.sync_committee_size
+        sub_size = size // SYNC_COMMITTEE_SUBNET_COUNT
         with self._lock:
             bucket = dict(self._messages.get((slot, beacon_block_root), {}))
-        bits = [i in bucket for i in range(size)]
-        sigs = [bucket[i] for i in sorted(bucket)]
+            contribs = {
+                sc: self._contributions.get((slot, beacon_block_root, sc))
+                for sc in range(SYNC_COMMITTEE_SUBNET_COUNT)}
+        bits: list[bool] = []
+        sigs: list[bytes] = []
+        for sc in range(SYNC_COMMITTEE_SUBNET_COUNT):
+            start = sc * sub_size
+            msg_positions = [i for i in range(start, start + sub_size)
+                             if i in bucket]
+            contrib = contribs[sc]
+            c_bits = (sum(map(bool, contrib.aggregation_bits))
+                      if contrib is not None else 0)
+            if contrib is not None and c_bits >= len(msg_positions):
+                bits.extend(bool(b) for b in contrib.aggregation_bits)
+                sigs.append(bytes(contrib.signature))
+            else:
+                bits.extend(i in bucket
+                            for i in range(start, start + sub_size))
+                sigs.extend(bucket[i] for i in msg_positions)
         agg = (bls.aggregate_signatures(sigs) if sigs
                else bls.INFINITY_SIGNATURE)
         return T.SyncAggregate(sync_committee_bits=bits,
@@ -99,3 +191,5 @@ class SyncCommitteePool:
         with self._lock:
             for k in [k for k in self._messages if k[0] < min_slot]:
                 del self._messages[k]
+            for k in [k for k in self._contributions if k[0] < min_slot]:
+                del self._contributions[k]

@@ -48,6 +48,7 @@ class Client:
         self.api_server: BeaconApiServer | None = None
         self.metrics_server: MetricsServer | None = None
         self.slasher: Slasher | None = None
+        self.discovery = None
         self.env: Environment | None = None
 
     def stop(self) -> None:
@@ -55,6 +56,8 @@ class Client:
             self.api_server.stop()
         if self.metrics_server:
             self.metrics_server.stop()
+        if self.discovery:
+            self.discovery.stop()   # owns a UDP socket + recv thread
         if self.network:
             self.network.stop()
 
@@ -138,6 +141,10 @@ class ClientBuilder:
                                         processor=client.processor)
         client.network.start()
         client.discovery = Discovery(client.network)
+        # advertise our subscribed subnets in the ENR (discovery/enr.rs)
+        n_subnets = client.chain.spec.preset.max_committees_per_slot
+        client.discovery.update_attnets((1 << n_subnets) - 1)
+        client.discovery.update_syncnets(0b1111)
 
         # http api + metrics
         if cfg.http_enabled:

@@ -1,11 +1,13 @@
-"""Peer discovery + standalone bootnode.
+"""Peer discovery: discv5 UDP Kademlia + standalone bootnode.
 
-Equivalent of the reference's discv5 discovery (lighthouse_network/src/
-discovery) and the boot_node binary (boot_node/src/server.rs), over the
-framed-TCP transport instead of UDP Kademlia: every node serves a
-`discovery_peers` RPC returning its known peer addresses; nodes poll it to
-top up toward target_peers. A bootnode is just a NetworkService-less
-Transport+RPC that only serves the address book.
+Equivalent of the reference's discovery service and boot_node binary
+(lighthouse_network/src/discovery/mod.rs — discv5 queries feeding dialable
+peers to the network; boot_node/src/server.rs — a discv5 server with no
+libp2p stack).  The wire layer lives in `discv5.py` (signed ENRs,
+WHOAREYOU/ECDH sessions, FINDNODE lookups, subnet predicates); this module
+binds it to the NetworkService: our ENR advertises the TCP (noise
+transport) port, lookups surface ENRs, and new peers are dialed over TCP
+until target_peers.
 
 Run standalone:  python -m lighthouse_tpu.network.discovery --port 9100
 """
@@ -13,114 +15,114 @@ from __future__ import annotations
 
 import argparse
 import sys
-import threading
 import time
 
-from .rpc import RpcHandler
-from .transport import Transport
-
-
-class AddressBook:
-    def __init__(self):
-        self._addrs: dict[str, tuple[str, int]] = {}
-        self._lock = threading.Lock()
-
-    def record(self, node_id: str, host: str, port: int) -> None:
-        with self._lock:
-            self._addrs[node_id] = (host, port)
-
-    def sample(self, exclude: set[str], limit: int = 16) -> list:
-        with self._lock:
-            return [[nid, h, p] for nid, (h, p) in self._addrs.items()
-                    if nid not in exclude][:limit]
-
-
-def record_identify(book: AddressBook, peer, payload) -> dict:
-    """Shared identify handler (node-side and bootnode-side)."""
-    try:
-        book.record(peer.node_id, payload["host"], int(payload["port"]))
-    except (KeyError, ValueError, TypeError):
-        pass
-    return {"ok": True}
+from .discv5 import Discv5, Enr
 
 
 class Discovery:
-    """Attach to a NetworkService: serve + poll peer exchange."""
+    """Attach to a NetworkService: discv5 lookups -> TCP dials."""
 
-    def __init__(self, service, listen_port: int | None = None):
+    def __init__(self, service, udp_port: int = 0,
+                 bootnode_enrs: list[Enr] | None = None):
         self.service = service
-        self.book = AddressBook()
-        self.listen_port = listen_port or service.port
-        service.rpc.register("discovery_peers", self._handle)
-        # learn dialable addresses from peers as they identify themselves
-        service.rpc.register(
-            "discovery_identify",
-            lambda peer, p: record_identify(self.book, peer, p))
+        self.disc = Discv5(ip=service.transport.host, port=udp_port,
+                           tcp_port=service.port,
+                           bootnodes=bootnode_enrs)
+        self.disc.start()
+        # addr -> transport peer id of the last successful dial, so a
+        # dropped connection can be re-dialed on a later round
+        self._dialed: dict[tuple[str, int], str] = {}
+        if bootnode_enrs:
+            self.disc.bootstrap()
 
-    def _handle(self, peer, payload) -> list:
-        exclude = {peer.node_id, self.service.transport.node_id}
-        return self.book.sample(exclude)
+    # -- identity ------------------------------------------------------------
 
-    def advertise(self, peer) -> None:
-        """Tell a peer our dialable address."""
-        try:
-            self.service.rpc.request(peer, "discovery_identify", {
-                "host": self.service.transport.host,
-                "port": self.listen_port}, timeout=3.0)
-        except (TimeoutError, RuntimeError):
-            pass
+    @property
+    def enr(self) -> Enr:
+        return self.disc.local_enr.record
+
+    def add_bootnode(self, enr: Enr) -> None:
+        self.disc.bootnodes.append(enr)
+        self.disc.table.update(enr)
+
+    # -- ENR subnet advertisement (discovery/enr.rs attnets/syncnets) --------
+
+    def update_attnets(self, bitfield: int) -> None:
+        self.disc.local_enr.set_attnets(bitfield)
+
+    def update_syncnets(self, bitfield: int) -> None:
+        self.disc.local_enr.set_syncnets(bitfield)
+
+    # -- discovery -----------------------------------------------------------
+
+    def _try_dial(self, enr: Enr) -> bool:
+        """Dial an ENR's TCP endpoint unless we already hold a live
+        connection from a previous dial of that address."""
+        svc = self.service
+        if enr.tcp_port == 0:
+            return False   # bootnode-style record: not dialable over TCP
+        addr = (enr.ip, enr.tcp_port)
+        if addr == (svc.transport.host, svc.port):
+            return False
+        live = self._dialed.get(addr)
+        if live is not None and live in svc.transport.peers:
+            return False   # still connected
+        peer = svc.dial(*addr)
+        if peer is None:
+            self._dialed.pop(addr, None)   # retry on a later round
+            return False
+        self._dialed[addr] = peer.node_id
+        return True
 
     def discover_once(self) -> int:
-        """Ask each connected peer for more peers; dial new ones until
-        target_peers. Returns new connections made."""
+        """One lookup round; dial found peers until target_peers.
+        Returns new connections made."""
         svc = self.service
-        known = set(svc.transport.peers) | {svc.transport.node_id}
+        self.disc.bootstrap()
         made = 0
-        for peer in list(svc.transport.peers.values()):
-            self.advertise(peer)
-            try:
-                found = svc.rpc.request(peer, "discovery_peers", {},
-                                        timeout=3.0)
-            except (TimeoutError, RuntimeError):
-                continue
-            for nid, host, port in found or []:
-                if nid in known:
-                    continue
-                if len(svc.transport.peers) >= svc.peers.target_peers:
-                    return made
-                if svc.dial(host, int(port)) is not None:
-                    known.add(nid)
-                    made += 1
+        for enr in self.disc.lookup():
+            if len(svc.transport.peers) >= svc.peers.target_peers:
+                break
+            if self._try_dial(enr):
+                made += 1
         return made
+
+    def discover_subnet_peers(self, subnet_id: int, n: int = 4,
+                              sync: bool = False) -> int:
+        """Find + dial peers advertising a subnet in their ENR
+        (discovery/mod.rs subnet predicate queries).  Returns dials made."""
+        made = 0
+        for enr in self.disc.discover_subnet_peers(subnet_id, n=n,
+                                                   sync=sync):
+            if self._try_dial(enr):
+                made += 1
+        return made
+
+    def stop(self) -> None:
+        self.disc.stop()
 
 
 class BootNode:
-    """Standalone address-book server (boot_node binary equivalent)."""
+    """Standalone discv5 server: routing table only, no beacon stack
+    (boot_node/src/server.rs)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self.transport = Transport(host, port)
-        self.rpc = RpcHandler(self.transport)
-        self.book = AddressBook()
-        self.transport.on_frame = \
-            lambda peer, kind, payload: self.rpc.handle_frame(peer, kind,
-                                                              payload)
-        self.rpc.register("discovery_peers",
-                          lambda peer, p: self.book.sample({peer.node_id}))
-        self.rpc.register(
-            "discovery_identify",
-            lambda peer, p: record_identify(self.book, peer, p))
-        self.rpc.register("status", lambda peer, p: p)  # echo, stay neutral
-        self.rpc.register("ping", lambda peer, p: {"seq": 0})
+        self.disc = Discv5(ip=host, port=port, tcp_port=0)
+
+    @property
+    def enr(self) -> Enr:
+        return self.disc.local_enr.record
 
     @property
     def port(self) -> int:
-        return self.transport.port
+        return self.disc.port
 
     def start(self) -> None:
-        self.transport.start()
+        self.disc.start()
 
     def stop(self) -> None:
-        self.transport.stop()
+        self.disc.stop()
 
 
 def main(argv=None) -> int:
@@ -130,7 +132,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     node = BootNode(args.host, args.port)
     node.start()
-    print(f"bootnode listening on {args.host}:{node.port}")
+    print(f"bootnode listening on {args.host}:{node.port} (udp)")
+    print(f"enr: {node.enr.encode().hex()}")
     try:
         while True:
             time.sleep(3600)

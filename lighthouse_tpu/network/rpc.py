@@ -148,49 +148,6 @@ def _dec_blocks(b: bytes) -> list:
     return out
 
 
-def _enc_identify(d: dict) -> bytes:
-    host = (d or {}).get("host", "").encode()
-    return struct.pack("<H", int((d or {}).get("port", 0))) + host
-
-
-def _dec_identify(b: bytes) -> dict:
-    if len(b) < 2 or len(b) > 2 + 255:
-        raise ValueError("bad identify size")
-    return {"port": struct.unpack_from("<H", b)[0],
-            "host": b[2:].decode()}
-
-
-def _enc_peer_list(entries: list) -> bytes:
-    """ENR-record-like entries: (node_id_hex, host, port)."""
-    out = bytearray()
-    for nid, host, port in entries or []:
-        nb, hb = bytes.fromhex(nid), host.encode()
-        out += bytes([len(nb)]) + nb + struct.pack("<H", int(port)) \
-            + bytes([len(hb)]) + hb
-    return bytes(out)
-
-
-def _dec_peer_list(b: bytes) -> list:
-    out = []
-    pos = 0
-    while pos < len(b):
-        nlen = b[pos]
-        if pos + 1 + nlen + 3 > len(b):
-            raise ValueError("truncated peer entry")
-        nid = b[pos + 1:pos + 1 + nlen].hex()
-        pos += 1 + nlen
-        (port,) = struct.unpack_from("<H", b, pos)
-        hlen = b[pos + 2]
-        if pos + 3 + hlen > len(b):
-            raise ValueError("truncated peer host")
-        host = b[pos + 3:pos + 3 + hlen].decode()
-        pos += 3 + hlen
-        out.append((nid, host, port))
-        if len(out) > 1024:
-            raise ValueError("peer list too long")
-    return out
-
-
 _PING_ENC, _PING_DEC = _enc_u64("seq")
 _GOODBYE_ENC, _GOODBYE_DEC = _enc_u64("reason")
 
@@ -204,10 +161,6 @@ CODECS: dict[str, tuple] = {
                                _enc_blocks, _dec_blocks),
     "beacon_blocks_by_root": (_enc_by_root, _dec_by_root,
                               _enc_blocks, _dec_blocks),
-    "discovery_identify": (_enc_identify, _dec_identify,
-                           _enc_empty, _dec_empty),
-    "discovery_peers": (_enc_empty, _dec_empty,
-                        _enc_peer_list, _dec_peer_list),
 }
 
 

@@ -127,3 +127,56 @@ def test_electra_pending_queues(api):
     h, srv = api
     out = _get(srv, "/eth/v1/beacon/states/head/pending_deposits")["data"]
     assert out == []    # altair state: empty, not an error
+
+
+def test_block_retrieval_and_withdrawals_routes(api):
+    """v2 full-block retrieval, expected withdrawals, validator
+    identities, v2 production, electra v2 pool aliases."""
+    h, srv = api
+    from lighthouse_tpu.ssz import deserialize
+    # v2 serves raw SSZ (octet-stream, checkpoint-sync path); the legacy
+    # v1 JSON alias carries the same bytes hex-encoded
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/eth/v2/beacon/blocks/head") as r:
+        raw = r.read()
+        assert r.headers.get("Content-Type") == "application/octet-stream"
+    fork = h.chain.spec.fork_name_at_slot(h.chain.slot())
+    cls = h.chain.T.SignedBeaconBlock[fork]
+    signed = deserialize(cls.ssz_type, raw)
+    assert signed.message.slot == h.chain.head().head_state.slot
+    legacy = _get(srv, "/eth/v1/beacon/blocks/head")
+    assert legacy["data"]["ssz"] == raw.hex()
+    # identities + POST validator filters
+    ids = _get(srv, "/eth/v1/beacon/states/head/validator_identities"
+                    "?id=0&id=1")["data"]
+    assert len(ids) == 2 and ids[0]["index"] == "0"
+    vals = _post(srv, "/eth/v1/beacon/states/head/validators",
+                 {"ids": ["0", "3"]})["data"]
+    assert len(vals) == 2
+    bals = _post(srv, "/eth/v1/beacon/states/head/validator_balances",
+                 ["1", "2"])["data"]
+    assert len(bals) == 2
+    # debug heads v2 + electra pool aliases respond
+    assert _get(srv, "/eth/v2/debug/beacon/heads")["data"]
+    _get(srv, "/eth/v2/beacon/pool/attester_slashings")
+    _get(srv, "/eth/v2/beacon/pool/attestations")
+
+
+def test_expected_withdrawals_route():
+    """Withdrawals need a capella+ chain."""
+    spec = minimal_spec(altair_fork_epoch=0, bellatrix_fork_epoch=0,
+                        capella_fork_epoch=0)
+    h = BeaconChainHarness(spec, 32)
+    h.extend_chain(3)
+    srv = BeaconApiServer(ApiBackend(h.chain))
+    srv.start()
+    try:
+        data = _get(
+            srv,
+            "/eth/v1/builder/states/head/expected_withdrawals")["data"]
+        assert isinstance(data, list)   # no full balances -> may be empty
+        for w in data:
+            assert set(w) == {"index", "validator_index", "address",
+                              "amount"}
+    finally:
+        srv.stop()

@@ -1,4 +1,5 @@
-"""Peer discovery through a bootnode: three nodes find each other."""
+"""discv5 discovery: ENRs, sessions, Kademlia lookups, subnet predicates,
+and the NetworkService integration (3 nodes mesh through one bootnode)."""
 import time
 
 import pytest
@@ -7,40 +8,119 @@ from lighthouse_tpu.chain import BeaconChainHarness
 from lighthouse_tpu.crypto import bls
 from lighthouse_tpu.network import NetworkService
 from lighthouse_tpu.network.discovery import BootNode, Discovery
+from lighthouse_tpu.network.discv5 import (
+    Discv5, Discv5Error, Enr, KBuckets, LocalEnr, log2_distance,
+)
 from lighthouse_tpu.specs import minimal_spec
 
 
-def test_bootnode_peer_exchange():
+def test_enr_roundtrip_and_tamper():
+    local = LocalEnr("127.0.0.1", 9999, tcp_port=9000)
+    local.set_attnets(0b1010)
+    blob = local.record.encode()
+    dec = Enr.decode(blob)
+    assert dec.node_id == local.node_id
+    assert dec.ip == "127.0.0.1" and dec.udp_port == 9999
+    assert dec.tcp_port == 9000 and dec.attnets() == 0b1010
+    # seq bumps on every update and old records lose to new ones
+    seq0 = dec.seq
+    local.set_syncnets(0b1)
+    assert local.record.seq == seq0 + 1
+    # any bit flip breaks the secp256k1 signature
+    bad = bytearray(blob)
+    bad[-1] ^= 1
+    with pytest.raises(Discv5Error):
+        Enr.decode(bytes(bad))
+
+
+def test_kbuckets_distance_and_eviction():
+    a = LocalEnr("127.0.0.1", 1).node_id
+    assert log2_distance(a, a) == 0
+    table = KBuckets(a)
+    enrs = [LocalEnr("127.0.0.1", 2 + i).record for i in range(8)]
+    for e in enrs:
+        table.update(e)
+    assert len(table) == 8
+    # closest() sorts by XOR distance to the target
+    target = enrs[3].node_id
+    assert table.closest(target, 1)[0].node_id == target
+    # updates with an equal/newer seq replace; remove() evicts
+    table.update(enrs[0])
+    assert len(table) == 8
+    table.remove(enrs[0].node_id)
+    assert len(table) == 7
+
+
+def test_discv5_mesh_sessions_and_subnet_predicates():
+    """5 nodes + bootnode: encrypted sessions form on demand, lookups
+    populate tables, ENR seq bumps propagate, subnet queries find the
+    advertisers."""
+    boot = Discv5()
+    boot.start()
+    nodes = [Discv5(bootnodes=[boot.local_enr.record]) for _ in range(5)]
+    try:
+        for n in nodes:
+            n.start()
+        for n in nodes:
+            n.bootstrap()
+        for n in nodes:
+            n.lookup()
+        assert all(len(n.table) >= 3 for n in nodes), \
+            [len(n.table) for n in nodes]
+        # liveness
+        assert nodes[0].ping(nodes[1].local_enr.record)
+        # subnet advertisement + rediscovery after seq bump
+        nodes[2].local_enr.set_attnets(1 << 7)
+        nodes[3].local_enr.set_attnets(1 << 7)
+        for src in (nodes[2], nodes[3]):
+            for e in src.table.all():
+                src.ping(e)   # announces the new seq; peers re-fetch
+        time.sleep(0.5)
+        found = nodes[0].discover_subnet_peers(7, n=4)
+        want = {nodes[2].local_enr.node_id, nodes[3].local_enr.node_id}
+        assert {e.node_id for e in found} & want
+        # a dead node is evicted from the table on ping failure
+        dead = nodes[4].local_enr.record
+        nodes[4].stop()
+        nodes[0].table.update(dead)
+        assert not nodes[0].ping(dead)
+        assert all(e.node_id != dead.node_id
+                   for e in nodes[0].table.all())
+    finally:
+        for n in nodes[:4] + [boot]:
+            n.stop()
+
+
+def test_network_service_discovers_and_dials():
+    """NetworkService nodes find each other via the bootnode's discv5
+    table and dial over TCP (the reference's discovery->libp2p flow)."""
     bls.set_backend("fake")
     spec = minimal_spec()
     boot = BootNode()
     boot.start()
-    services = []
-    discos = []
+    services, discos = [], []
     try:
         for _ in range(3):
             h = BeaconChainHarness(spec, 64)
             svc = NetworkService(h.chain)
             svc.start()
-            disco = Discovery(svc)
-            peer = svc.dial("127.0.0.1", boot.port)
-            assert peer is not None
-            disco.advertise(peer)
+            disco = Discovery(svc, bootnode_enrs=[boot.enr])
             services.append(svc)
             discos.append(disco)
-        # each node asks the bootnode for peers and dials them
         total_new = 0
         for disco in discos:
             total_new += disco.discover_once()
         time.sleep(0.3)
-        # node 0 and node 2 should now be connected even though neither
-        # dialed the other directly
-        mesh_ok = sum(
-            1 for svc in services
-            if len([p for p in svc.transport.peers.values()]) >= 2)
         assert total_new >= 2
+        mesh_ok = sum(1 for svc in services
+                      if len(svc.transport.peers) >= 2)
         assert mesh_ok >= 2, [len(s.transport.peers) for s in services]
+        # ENR carries the dialable TCP port
+        for svc, disco in zip(services, discos):
+            assert disco.enr.tcp_port == svc.port
     finally:
+        for disco in discos:
+            disco.stop()
         for svc in services:
             svc.stop()
         boot.stop()
