@@ -28,6 +28,28 @@ from ..ssz import (
 )
 from ..ssz.codec import BYTES_PER_LENGTH_OFFSET, DeserializeError, deserialize
 from ..utils.hash import ZERO_HASHES, hash_concat
+
+_USE_HOST_HASH = None
+
+
+def _use_host_hash() -> bool:
+    """True when the big-column rehash should run on the HOST (SHA-NI
+    C++ batch hasher) instead of the XLA kernels: no accelerator attached
+    (CPU backend) and the native library builds.  This mirrors the
+    reference's sha2-asm host path; the device kernels stay the TPU
+    path."""
+    global _USE_HOST_HASH
+    if _USE_HOST_HASH is None:
+        from ..utils import native_hash as nh
+        if nh.get_lib() is None:
+            _USE_HOST_HASH = False
+        else:
+            try:
+                import jax
+                _USE_HOST_HASH = jax.default_backend() == "cpu"
+            except Exception:
+                _USE_HOST_HASH = True
+    return _USE_HOST_HASH
 from .core import Types, get_types
 
 
@@ -101,6 +123,10 @@ class ValidatorRegistry:
         # validator rows need re-encoding + scatter
         self._device_leaves = None
         self._dirty_rows: set[int] | None = None
+        # host-native twin (SHA-NI path when no accelerator is attached):
+        # incremental merkle tree, shared copy-on-write across copies
+        self._host_tree = None
+        self._host_shared = False
 
     def __len__(self) -> int:
         return self.pubkeys.shape[0]
@@ -180,6 +206,13 @@ class ValidatorRegistry:
         out._device_leaves = self._device_leaves
         out._dirty_rows = (set(self._dirty_rows)
                            if self._dirty_rows is not None else None)
+        # share the host merkle tree copy-on-write: whoever refreshes
+        # next copies the levels first
+        host = getattr(self, "_host_tree", None)
+        out._host_tree = host
+        if host is not None:
+            self._host_shared = True
+        out._host_shared = host is not None
         return out
 
     # -- merkleization -------------------------------------------------------
@@ -224,6 +257,71 @@ class ValidatorRegistry:
         chunks[:, 7, :2] = u64w(self.withdrawable_epoch)
         return chunks.reshape(n * 8, 8)
 
+    def validator_leaf_bytes(self, rows: np.ndarray | None = None
+                             ) -> np.ndarray:
+        """u8[R, 8, 32]: the 8 field chunks per validator with the pubkey
+        pre-hashed on the HOST (SHA-NI batch) — the no-accelerator twin of
+        validator_leaf_words."""
+        from ..utils import native_hash as nh
+
+        def col(a):
+            return a if rows is None else a[rows]
+
+        n = len(self) if rows is None else len(rows)
+        out = np.zeros((n, 8, 32), dtype=np.uint8)
+        pk_blocks = np.zeros((n, 64), dtype=np.uint8)
+        pk_blocks[:, :48] = col(self.pubkeys)
+        out[:, 0] = np.frombuffer(
+            nh.hash64_batch(pk_blocks.tobytes()),
+            dtype=np.uint8).reshape(n, 32)
+        out[:, 1] = col(self.withdrawal_credentials)
+
+        def u64b(a):
+            return np.frombuffer(
+                np.ascontiguousarray(col(a)).astype("<u8").tobytes(),
+                dtype=np.uint8).reshape(n, 8)
+
+        out[:, 2, :8] = u64b(self.effective_balance)
+        out[:, 3, 0] = col(self.slashed).astype(np.uint8)
+        out[:, 4, :8] = u64b(self.activation_eligibility_epoch)
+        out[:, 5, :8] = u64b(self.activation_epoch)
+        out[:, 6, :8] = u64b(self.exit_epoch)
+        out[:, 7, :8] = u64b(self.withdrawable_epoch)
+        return out
+
+    def _validator_roots(self, rows: np.ndarray | None = None) -> np.ndarray:
+        """u8[R, 32]: per-validator hash-tree-roots (3 SHA-NI levels over
+        the 8 field chunks), host-side."""
+        from ..utils import native_hash as nh
+        buf = self.validator_leaf_bytes(rows).tobytes()
+        for _ in range(3):
+            buf = nh.hash64_batch(buf)
+        n = len(self) if rows is None else len(rows)
+        return np.frombuffer(buf, np.uint8).reshape(n, 32)
+
+    def _host_tree_root(self, registry_limit: int) -> bytes:
+        """Host rehash with incremental update_tree_hash_cache semantics:
+        an all-levels SHA-NI tree over the per-validator roots, re-hashing
+        only dirty validators' paths."""
+        from ..utils import native_hash as nh
+        n = len(self)
+        tree = getattr(self, "_host_tree", None)
+        dirty = self._dirty_rows
+        if tree is None or dirty is None or tree.n != n:
+            self._host_tree = nh.HostTree(self._validator_roots(),
+                                          registry_limit)
+            self._host_shared = False
+        elif dirty:
+            if getattr(self, "_host_shared", False):
+                self._host_tree = self._host_tree.copy()
+                self._host_shared = False
+            rows = np.fromiter(dirty, dtype=np.int64)
+            rows.sort()
+            self._host_tree.update(rows, self._validator_roots(rows))
+        self._dirty_rows = set()
+        self._device_leaves = None   # consumed the dirty set
+        return mix_in_length(self._host_tree.root(), n)
+
     def _refresh_device_leaves(self):
         """Keep u32[N*8, 8] leaf words device-resident; re-encode + scatter
         only dirty rows (milhouse-style O(diff) updates; the steady-state
@@ -261,6 +359,8 @@ class ValidatorRegistry:
         if n == 0:
             depth = (registry_limit - 1).bit_length()
             root = mix_in_length(ZERO_HASHES[depth], 0)
+        elif _use_host_hash():
+            root = self._host_tree_root(registry_limit)
         else:
             nodes = self._refresh_device_leaves()
             for _ in range(3):  # 8 field chunks -> 1 root per validator
@@ -342,12 +442,13 @@ class BalancesColumn:
     def __len__(self) -> int:
         return self.values.shape[0]
 
-    def _chunk_words(self, chunks: np.ndarray | None = None) -> np.ndarray:
-        """u32[C, 8] big-endian words of the packed-u64 chunks."""
-        from ..ops import sha256 as k
+    def _chunk_bytes(self, chunks: np.ndarray | None = None) -> np.ndarray:
+        """u8[C, 32] packed-u64 chunk bytes (4 balances per chunk), for
+        the whole column or a chunk subset — the single source of the
+        chunk layout for both the host and device paths."""
         n = len(self)
-        n_chunks = (n + 3) // 4
         if chunks is None:
+            n_chunks = (n + 3) // 4
             padded = np.zeros(n_chunks * 4, dtype=np.uint64)
             padded[:n] = self.values
         else:
@@ -355,7 +456,13 @@ class BalancesColumn:
             for j, c in enumerate(chunks):
                 vals = self.values[c * 4:c * 4 + 4]
                 padded[j, :len(vals)] = vals
-        return k.chunks_to_words(padded.astype("<u8").tobytes())
+        return np.frombuffer(padded.astype("<u8").tobytes(),
+                             np.uint8).reshape(-1, 32)
+
+    def _chunk_words(self, chunks: np.ndarray | None = None) -> np.ndarray:
+        """u32[C, 8] big-endian words of the packed-u64 chunks."""
+        from ..ops import sha256 as k
+        return k.chunks_to_words(self._chunk_bytes(chunks).tobytes())
 
     def set_many(self, rows: np.ndarray, values: np.ndarray) -> None:
         self.values[rows] = values
@@ -406,6 +513,21 @@ class BalancesColumn:
         if n == 0:
             depth = (limit_chunks - 1).bit_length()
             root = mix_in_length(ZERO_HASHES[depth], 0)
+        elif _use_host_hash():
+            from ..utils import native_hash as nh
+            n_chunks = (n + 3) // 4
+            tree = getattr(self, "_host_tree", None)
+            if tree is None or self._dirty_chunks is None \
+                    or tree.n != n_chunks:
+                self._host_tree = nh.HostTree(self._chunk_bytes(),
+                                              limit_chunks)
+            elif self._dirty_chunks:
+                idx = np.fromiter(self._dirty_chunks, dtype=np.int64)
+                idx.sort()
+                self._host_tree.update(idx, self._chunk_bytes(idx))
+            self._dirty_chunks = set()
+            self._device_leaves = None
+            root = mix_in_length(self._host_tree.root(), n)
         else:
             leaves = self._refresh_device_leaves()
             root_words = k.merkleize_words(leaves, limit_chunks)

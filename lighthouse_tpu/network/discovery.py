@@ -77,9 +77,12 @@ class Discovery:
 
     def discover_once(self) -> int:
         """One lookup round; dial found peers until target_peers.
-        Returns new connections made."""
+        Returns new connections made.  (Runs on the per-slot timer: only
+        re-bootstrap — serial bootnode pings with 2 s timeouts — when the
+        table is empty, so an unreachable bootnode cannot stall slots.)"""
         svc = self.service
-        self.disc.bootstrap()
+        if len(self.disc.table) == 0 and self.disc.bootnodes:
+            self.disc.bootstrap()
         made = 0
         for enr in self.disc.lookup():
             if len(svc.transport.peers) >= svc.peers.target_peers:

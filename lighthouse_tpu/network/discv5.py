@@ -415,6 +415,10 @@ class Discv5:
         Keys ride static-static ECDH bound to the challenge nonce, so a
         spoofed source address cannot decrypt (spec 4.1 handshake).
         """
+        # being challenged means the peer cannot decrypt us: any session
+        # we hold for this address is stale (peer restarted) — drop it so
+        # the next request re-handshakes even if nothing is queued now
+        self.sessions.pop(addr, None)
         with self._lock:
             queued = self.pending_out.pop(addr, [])
         if not queued:
@@ -581,7 +585,10 @@ class Discv5:
         optionally filtering results with `predicate(enr) -> bool`."""
         target = target or os.urandom(32)
         seen: set[bytes] = {self.local_enr.node_id}
-        results: dict[bytes, Enr] = {}
+        # seed with our own table: known peers count as results even when
+        # no third party reports them (two-node networks must connect)
+        results: dict[bytes, Enr] = {
+            e.node_id: e for e in self.table.closest(target, K_BUCKET_SIZE)}
         frontier = self.table.closest(target, LOOKUP_PARALLELISM)
         for _ in range(rounds):
             if not frontier:

@@ -35,6 +35,15 @@ def get_lib():
                                            ctypes.c_char_p, ctypes.c_char_p]
         lib.sha256_oneshot.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                        ctypes.c_char_p]
+        try:   # threaded entry points (absent in a stale .so)
+            lib.sha256_merkle_root_mt.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_uint32]
+            lib.sha256_hash64_batch_mt.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_uint32]
+        except AttributeError:
+            pass
         _lib = lib
     except Exception:
         _lib = None
@@ -55,11 +64,89 @@ def hash64_batch(data: bytes) -> bytes:
     return out.raw
 
 
-def merkle_root_pow2(leaves: bytes) -> bytes:
-    """Dense merkle root of a power-of-two number of 32-byte leaves."""
+def merkle_root_pow2(leaves: bytes, threads: int | None = None) -> bytes:
+    """Dense merkle root of a power-of-two number of 32-byte leaves
+    (threaded across cores for big trees when the .so supports it)."""
+    import os
     lib = get_lib()
     n = len(leaves) // 32
     root = ctypes.create_string_buffer(32)
-    scratch = ctypes.create_string_buffer(max(32, (n // 2) * 32))
-    lib.sha256_merkle_root(leaves, n, root, scratch)
+    t = threads if threads is not None else (os.cpu_count() or 1)
+    if t > 1 and hasattr(lib, "sha256_merkle_root_mt"):
+        # the threaded variant ping-pongs levels across two scratch halves
+        scratch = ctypes.create_string_buffer(max(64, n * 32))
+        lib.sha256_merkle_root_mt(leaves, n, root, scratch, t)
+    else:
+        scratch = ctypes.create_string_buffer(max(32, (n // 2) * 32))
+        lib.sha256_merkle_root(leaves, n, root, scratch)
     return root.raw
+
+
+class HostTree:
+    """Incremental dense merkle tree over 32-byte chunks on the host
+    hasher: build all levels once, then re-hash only the root paths of
+    dirty chunks (the `update_tree_hash_cache` semantics of the
+    reference's tree-states, on SHA-NI instead of a persistent tree).
+
+    Memory: 2x the padded leaf bytes.  Update cost: O(dirty * depth)
+    hashes instead of O(n)."""
+
+    def __init__(self, chunks: np.ndarray, limit_chunks: int):
+        n = int(chunks.shape[0])
+        self.n = n
+        self.limit_depth = max(0, (limit_chunks - 1).bit_length())
+        dense = 1 if n <= 1 else 1 << (n - 1).bit_length()
+        level0 = np.zeros((dense, 32), np.uint8)
+        level0[:n] = chunks
+        self.levels = [level0]
+        size = dense
+        while size > 1:
+            out = hash64_batch(self.levels[-1].tobytes())
+            self.levels.append(
+                np.frombuffer(out, np.uint8).reshape(size // 2, 32).copy())
+            size //= 2
+
+    def update(self, idx: np.ndarray, new_chunks: np.ndarray) -> None:
+        """Overwrite chunks at `idx` and re-hash their paths to the root."""
+        self.levels[0][idx] = new_chunks
+        cur = np.unique(np.asarray(idx, dtype=np.int64) // 2)
+        for li in range(1, len(self.levels)):
+            pairs = self.levels[li - 1].reshape(-1, 64)[cur]
+            out = hash64_batch(pairs.tobytes())
+            self.levels[li][cur] = np.frombuffer(
+                out, np.uint8).reshape(len(cur), 32)
+            cur = np.unique(cur // 2)
+
+    def copy(self) -> "HostTree":
+        out = HostTree.__new__(HostTree)
+        out.n = self.n
+        out.limit_depth = self.limit_depth
+        out.levels = [lvl.copy() for lvl in self.levels]
+        return out
+
+    def root(self) -> bytes:
+        from .hash import ZERO_HASHES, hash_concat
+        r = self.levels[-1][0].tobytes()
+        dense_depth = (int(self.levels[0].shape[0]) - 1).bit_length()
+        for d in range(dense_depth, self.limit_depth):
+            r = hash_concat(r, ZERO_HASHES[d])
+        return r
+
+
+def merkle_root_capped(leaves: bytes, n_chunks: int, limit_chunks: int
+                       ) -> bytes:
+    """Root of `n_chunks` 32-byte leaves under a virtual tree of
+    `limit_chunks` leaves: pad to a power of two, dense-hash natively,
+    fold in the zero-subtree caps (the host twin of
+    ops.sha256.merkleize_words)."""
+    from .hash import ZERO_HASHES, hash_concat
+    limit_depth = max(0, (limit_chunks - 1).bit_length())
+    if n_chunks == 0:
+        return ZERO_HASHES[limit_depth]
+    dense = 1 if n_chunks <= 1 else 1 << (n_chunks - 1).bit_length()
+    if dense * 32 != len(leaves):
+        leaves = leaves + b"\x00" * (dense * 32 - len(leaves))
+    root = merkle_root_pow2(leaves)
+    for d in range((dense - 1).bit_length(), limit_depth):
+        root = hash_concat(root, ZERO_HASHES[d])
+    return root

@@ -4,7 +4,7 @@ set -e
 cd "$(dirname "$0")"
 g++ -O2 -std=c++17 -shared -fPIC -o libkvstore.so kvstore.cpp
 if [ -f sha256_host.cpp ]; then
-  g++ -O3 -std=c++17 -march=native -shared -fPIC -o libsha256host.so sha256_host.cpp
+  g++ -O3 -std=c++17 -march=native -shared -fPIC -pthread -o libsha256host.so sha256_host.cpp
 fi
 if [ -f bls12_381.cpp ]; then
   g++ -O3 -std=c++17 -march=native -shared -fPIC -pthread -o libbls12381.so bls12_381.cpp

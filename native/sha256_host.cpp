@@ -10,6 +10,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 #if defined(__x86_64__)
 #include <cpuid.h>
@@ -193,6 +195,52 @@ void sha256_merkle_root(const uint8_t* leaves, uint64_t n_leaves,
     n /= 2;
   }
   memcpy(root_out, scratch, 32);
+}
+
+// threaded batch: split the independent 64B->32B hashes across threads
+// (each level of a big merkle tree is embarrassingly parallel)
+void sha256_hash64_batch_mt(const uint8_t* in, uint8_t* out, uint64_t n,
+                            uint32_t threads) {
+  if (n < 1u << 14 || threads <= 1) {  // small levels: threading overhead
+    sha256_hash64_batch(in, out, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  uint64_t per = (n + threads - 1) / threads;
+  for (uint32_t t = 0; t < threads; t++) {
+    uint64_t s = t * per;
+    uint64_t e = s + per < n ? s + per : n;
+    if (s >= e) break;
+    ts.emplace_back([in, out, s, e] {
+      sha256_hash64_batch(in + 64 * s, out + 32 * s, e - s);
+    });
+  }
+  for (auto& th : ts) th.join();
+}
+
+// full dense merkle root, threaded per level.
+// scratch must hold n_leaves * 32 bytes: levels ping-pong between its two
+// halves, because the threaded batch may not run in place (a thread's
+// output range overlaps another thread's still-unread input range).
+void sha256_merkle_root_mt(const uint8_t* leaves, uint64_t n_leaves,
+                           uint8_t* root_out, uint8_t* scratch,
+                           uint32_t threads) {
+  if (n_leaves == 1) {
+    memcpy(root_out, leaves, 32);
+    return;
+  }
+  uint8_t* a = scratch;
+  uint8_t* b = scratch + (n_leaves / 2) * 32;
+  uint64_t n = n_leaves / 2;
+  sha256_hash64_batch_mt(leaves, a, n, threads);
+  uint8_t* cur = a;
+  uint8_t* nxt = b;
+  while (n > 1) {
+    sha256_hash64_batch_mt(cur, nxt, n / 2, threads);
+    uint8_t* t = cur; cur = nxt; nxt = t;
+    n /= 2;
+  }
+  memcpy(root_out, cur, 32);
 }
 
 // general sha256
