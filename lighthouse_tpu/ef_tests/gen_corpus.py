@@ -459,6 +459,174 @@ def gen_state_cases(root: Path) -> int:
     return n
 
 
+def _spec_shuffled_index(index: int, count: int, seed: bytes,
+                         rounds: int) -> int:
+    """INDEPENDENT scalar transcription of the spec's
+    compute_shuffled_index (phase0 spec pseudocode), deliberately not
+    importing state_transition.shuffle — the vectorized implementation is
+    what the runner checks against these vectors."""
+    for r in range(rounds):
+        pivot = int.from_bytes(hashlib.sha256(
+            seed + bytes([r])).digest()[:8], "little") % count
+        flip = (pivot + count - index) % count
+        position = max(index, flip)
+        source = hashlib.sha256(
+            seed + bytes([r]) + (position // 256).to_bytes(4,
+                                                           "little")).digest()
+        byte = source[(position % 256) // 8]
+        if (byte >> (position % 8)) % 2:
+            index = flip
+    return index
+
+
+def gen_shuffling(root: Path) -> int:
+    """tests/minimal/phase0/shuffling/core/shuffle/* — mapping[i] =
+    compute_shuffled_index(i, count, seed) at the minimal preset's 10
+    rounds (consensus/swap_or_not_shuffle test layout)."""
+    n = 0
+    rng_seeds = [bytes([i]) * 32 for i in (0, 7, 42)]
+    for count in (1, 2, 3, 8, 33, 100):
+        for seed in rng_seeds:
+            mapping = [_spec_shuffled_index(i, count, seed, 10)
+                       for i in range(count)]
+            d = wcase(root, "minimal", "phase0", "shuffling", "core",
+                      "shuffle", f"shuffle_0x{seed[:4].hex()}_{count}")
+            w_yaml(d, "mapping.yaml", {
+                "seed": "0x" + seed.hex(), "count": count,
+                "mapping": mapping})
+            n += 1
+    return n
+
+
+def gen_kzg(root: Path) -> int:
+    """tests/general/deneb/kzg/* + fulu cells cases over the devnet
+    trusted setup (size 16, 8 cells).  Generated with the native
+    C++ MSM/pairing DISABLED (pure-python group arithmetic); the runner
+    verifies with whatever backend is live — on this image the native
+    library, making generation and verification independent
+    implementations of the group math."""
+    from ..crypto import kzg as kzgmod
+    old_native = kzgmod._NATIVE
+    kzgmod._NATIVE = False        # force pure-python generation
+    try:
+        k = kzgmod.Kzg(devnet_size=16, cells_per_ext_blob=8)
+        blobs = [
+            b"".join(((j * 17 + s) % kzgmod.R).to_bytes(32, "big")
+                     for j in range(16))
+            for s in (1, 5)]
+        n = 0
+        comms = [k.blob_to_kzg_commitment(b) for b in blobs]
+        proofs = [k.compute_blob_kzg_proof(b, c)
+                  for b, c in zip(blobs, comms)]
+        for i, (b, c, p) in enumerate(zip(blobs, comms, proofs)):
+            d = wcase(root, "general", "deneb", "kzg",
+                      "blob_to_kzg_commitment", "kzg-devnet", f"case_{i}")
+            w_yaml(d, "data.yaml", {
+                "input": {"blob": "0x" + b.hex()},
+                "output": "0x" + c.hex()})
+            n += 1
+            d = wcase(root, "general", "deneb", "kzg",
+                      "verify_blob_kzg_proof", "kzg-devnet", f"case_{i}")
+            w_yaml(d, "data.yaml", {
+                "input": {"blob": "0x" + b.hex(),
+                          "commitment": "0x" + c.hex(),
+                          "proof": "0x" + p.hex()},
+                "output": True})
+            n += 1
+        # an invalid proof case (proof from the other blob)
+        d = wcase(root, "general", "deneb", "kzg",
+                  "verify_blob_kzg_proof", "kzg-devnet", "case_invalid")
+        w_yaml(d, "data.yaml", {
+            "input": {"blob": "0x" + blobs[0].hex(),
+                      "commitment": "0x" + comms[0].hex(),
+                      "proof": "0x" + proofs[1].hex()},
+            "output": False})
+        n += 1
+        d = wcase(root, "general", "deneb", "kzg",
+                  "verify_blob_kzg_proof_batch", "kzg-devnet", "case_0")
+        w_yaml(d, "data.yaml", {
+            "input": {"blobs": ["0x" + b.hex() for b in blobs],
+                      "commitments": ["0x" + c.hex() for c in comms],
+                      "proofs": ["0x" + p.hex() for p in proofs]},
+            "output": True})
+        n += 1
+        # fulu cells: compute + verify + recover
+        cells, cproofs = k.compute_cells_and_kzg_proofs(blobs[0])
+        d = wcase(root, "general", "fulu", "kzg",
+                  "compute_cells_and_kzg_proofs", "kzg-devnet", "case_0")
+        w_yaml(d, "data.yaml", {
+            "input": {"blob": "0x" + blobs[0].hex()},
+            "output": [["0x" + c.hex() for c in cells],
+                       ["0x" + p.hex() for p in cproofs]]})
+        n += 1
+        d = wcase(root, "general", "fulu", "kzg",
+                  "verify_cell_kzg_proof_batch", "kzg-devnet", "case_0")
+        w_yaml(d, "data.yaml", {
+            "input": {"commitments": ["0x" + comms[0].hex()] * 3,
+                      "cell_indices": [0, 3, 7],
+                      "cells": ["0x" + cells[i].hex() for i in (0, 3, 7)],
+                      "proofs": ["0x" + cproofs[i].hex()
+                                 for i in (0, 3, 7)]},
+            "output": True})
+        n += 1
+        keep = [1, 3, 4, 6]
+        d = wcase(root, "general", "fulu", "kzg",
+                  "recover_cells_and_kzg_proofs", "kzg-devnet", "case_0")
+        w_yaml(d, "data.yaml", {
+            "input": {"cell_indices": keep,
+                      "cells": ["0x" + cells[i].hex() for i in keep]},
+            "output": [["0x" + c.hex() for c in cells],
+                       ["0x" + p.hex() for p in cproofs]]})
+        n += 1
+        return n
+    finally:
+        kzgmod._NATIVE = old_native
+
+
+def gen_transition(root: Path) -> int:
+    """tests/minimal/<post_fork>/transition/core/pyspec_tests/*: a chain
+    crossing the fork boundary — pre-fork pre-state, blocks on both
+    sides, post-fork post-state (EF transition layout)."""
+    from ..crypto import bls
+    bls.set_backend("python")
+    from ..chain.harness import BeaconChainHarness
+    from ..specs import minimal_spec
+    from ..ssz import serialize
+
+    n = 0
+    for post_fork, overrides in (
+            ("altair", {"altair_fork_epoch": 1}),
+            ("bellatrix", {"altair_fork_epoch": 0,
+                           "bellatrix_fork_epoch": 1}),
+    ):
+        spec = minimal_spec(**overrides)
+        h = BeaconChainHarness(spec, 16)
+        spe = spec.preset.slots_per_epoch
+        # blocks from 2 slots before the boundary to 2 after
+        pre_slot = spe - 3
+        h.extend_chain(pre_slot)
+        pre = h.chain.head().head_state.copy()
+        blocks = []
+        for _ in range(4):
+            block_root = h.extend_chain(1)[0]
+            blocks.append(h.chain.store.get_block(block_root))
+        post = h.chain.head().head_state
+        d = wcase(root, "minimal", post_fork, "transition", "core",
+                  "pyspec_tests", f"normal_transition_{post_fork}")
+        w_yaml(d, "meta.yaml", {
+            "post_fork": post_fork, "fork_epoch": 1,
+            "blocks_count": len(blocks),
+            "fork_block": 1,   # index of the last pre-fork block
+        })
+        _write_state(d, "pre.ssz_snappy", pre)
+        for i, b in enumerate(blocks):
+            w_ssz(d, f"blocks_{i}.ssz_snappy",
+                  serialize(type(b).ssz_type, b))
+        _write_state(d, "post.ssz_snappy", post)
+        n += 1
+    return n
+
+
 def main(dest: str | None = None) -> None:
     dest_root = Path(dest or Path(__file__).resolve().parents[2]
                      / "tests" / "ef_vectors" / "tests")
@@ -468,6 +636,9 @@ def main(dest: str | None = None) -> None:
     n += gen_ssz_static(dest_root)
     n += gen_bls(dest_root)
     n += gen_state_cases(dest_root)
+    n += gen_shuffling(dest_root)
+    n += gen_kzg(dest_root)
+    n += gen_transition(dest_root)
     print(f"wrote {n} cases under {dest_root}")
 
 

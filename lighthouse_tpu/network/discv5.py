@@ -415,14 +415,21 @@ class Discv5:
         Keys ride static-static ECDH bound to the challenge nonce, so a
         spoofed source address cannot decrypt (spec 4.1 handshake).
         """
-        # being challenged means the peer cannot decrypt us: any session
-        # we hold for this address is stale (peer restarted) — drop it so
-        # the next request re-handshakes even if nothing is queued now
-        self.sessions.pop(addr, None)
+        # Only honor a WHOAREYOU when we actually have traffic in flight
+        # toward that address (queued messages or an outstanding request):
+        # an unsolicited challenge from a spoofed source must not be able
+        # to evict a live session (session-churn DoS).
         with self._lock:
             queued = self.pending_out.pop(addr, [])
-        if not queued:
+            outstanding = any(st.get("addr") == addr
+                              for st in self.requests.values())
+        if not queued and not outstanding:
             return
+        # being challenged means the peer cannot decrypt us: our session
+        # is stale (peer restarted) — drop it so requests re-handshake
+        self.sessions.pop(addr, None)
+        if not queued:
+            return   # in-flight request times out; its retry re-queues
         dest = self._enr_for_addr(addr)
         if dest is None:
             return
@@ -523,7 +530,7 @@ class Discv5:
         req_id = secrets.token_bytes(8)
         msg = _enc_msg(msg_type, req_id, body)
         ev = threading.Event()
-        st = {"event": ev, "response": None}
+        st = {"event": ev, "response": None, "addr": addr}
         with self._lock:
             self.requests[req_id] = st
         sess = self.sessions.get(addr)

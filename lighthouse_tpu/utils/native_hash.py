@@ -133,20 +133,3 @@ class HostTree:
         return r
 
 
-def merkle_root_capped(leaves: bytes, n_chunks: int, limit_chunks: int
-                       ) -> bytes:
-    """Root of `n_chunks` 32-byte leaves under a virtual tree of
-    `limit_chunks` leaves: pad to a power of two, dense-hash natively,
-    fold in the zero-subtree caps (the host twin of
-    ops.sha256.merkleize_words)."""
-    from .hash import ZERO_HASHES, hash_concat
-    limit_depth = max(0, (limit_chunks - 1).bit_length())
-    if n_chunks == 0:
-        return ZERO_HASHES[limit_depth]
-    dense = 1 if n_chunks <= 1 else 1 << (n_chunks - 1).bit_length()
-    if dense * 32 != len(leaves):
-        leaves = leaves + b"\x00" * (dense * 32 - len(leaves))
-    root = merkle_root_pow2(leaves)
-    for d in range((dense - 1).bit_length(), limit_depth):
-        root = hash_concat(root, ZERO_HASHES[d])
-    return root

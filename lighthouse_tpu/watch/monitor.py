@@ -4,12 +4,38 @@ Follows a chain (in-process or via the API backend), recording:
 - canonical blocks: slot, proposer, attestation count, packing efficiency
   (fraction of available pool attestations included — block_packing),
 - per-epoch participation balances (suboptimal_attestations analog),
-- per-validator proposal counts (blockprint-lite).
+- per-validator proposal counts,
+- blockprint client classification: the reference's watch stores a
+  per-block consensus-client guess from the external blockprint service
+  (watch/src/blockprint); here the classifier is an in-process graffiti
+  fingerprint with the same storage/query surface (per-block label +
+  network diversity summary).
 """
 from __future__ import annotations
 
 import sqlite3
 import threading
+
+#: graffiti fingerprints -> consensus client (blockprint-style labels)
+_CLIENT_PATTERNS = [
+    (b"lighthouse_tpu", "LighthouseTpu"),
+    (b"lighthouse", "Lighthouse"),
+    (b"teku", "Teku"),
+    (b"nimbus", "Nimbus"),
+    (b"prysm", "Prysm"),
+    (b"lodestar", "Lodestar"),
+    (b"grandine", "Grandine"),
+]
+
+
+def classify_graffiti(graffiti: bytes) -> str:
+    """Best-guess client label from the block graffiti (the in-process
+    stand-in for the blockprint ML service's best_guess_single)."""
+    low = bytes(graffiti).rstrip(b"\x00").lower()
+    for pat, label in _CLIENT_PATTERNS:
+        if pat in low:
+            return label
+    return "Unknown"
 
 
 class WatchMonitor:
@@ -28,6 +54,8 @@ class WatchMonitor:
             justified INTEGER, finalized INTEGER);
         CREATE TABLE IF NOT EXISTS proposer_counts (
             validator INTEGER PRIMARY KEY, proposals INTEGER);
+        CREATE TABLE IF NOT EXISTS blockprint (
+            slot INTEGER PRIMARY KEY, best_guess TEXT);
         """)
         self._last_slot = -1
 
@@ -60,6 +88,9 @@ class WatchMonitor:
                     (slot, root, blk.message.proposer_index,
                      len(body.attestations), len(body.deposits),
                      len(body.voluntary_exits), sync_part))
+                self._db.execute(
+                    "INSERT OR REPLACE INTO blockprint VALUES (?, ?)",
+                    (slot, classify_graffiti(bytes(body.graffiti))))
                 self._db.execute(
                     "INSERT INTO proposer_counts VALUES (?, 1) "
                     "ON CONFLICT(validator) DO UPDATE SET "
@@ -114,6 +145,24 @@ class WatchMonitor:
                 "SELECT validator, proposals FROM proposer_counts "
                 "ORDER BY proposals DESC LIMIT ?", (limit,)))
 
+    def blockprint_block(self, slot: int):
+        with self._lock:
+            row = self._db.execute(
+                "SELECT best_guess FROM blockprint WHERE slot = ?",
+                (slot,)).fetchone()
+        return row[0] if row else None
+
+    def blockprint_diversity(self):
+        """Client share over all ingested blocks (watch blockprint's
+        blocks_per_client)."""
+        with self._lock:
+            rows = list(self._db.execute(
+                "SELECT best_guess, COUNT(*) FROM blockprint "
+                "GROUP BY best_guess ORDER BY COUNT(*) DESC"))
+        total = sum(n for _, n in rows) or 1
+        return [{"client": c, "blocks": n, "share": n / total}
+                for c, n in rows]
+
     def missed_slots(self, start_slot: int, end_slot: int) -> list[int]:
         with self._lock:
             have = {r[0] for r in self._db.execute(
@@ -130,6 +179,8 @@ class WatchServer:
       GET /v1/validators/proposers     top proposers
       GET /v1/epochs/{epoch}           participation summary
       GET /v1/slots/missed?start=&end= missed slots
+      GET /v1/blockprint/blocks/{slot} client guess for a block
+      GET /v1/blockprint/diversity     client-share summary
     """
 
     def __init__(self, monitor: WatchMonitor, host: str = "127.0.0.1",
@@ -186,6 +237,16 @@ class WatchServer:
                             return self._json(404, {"message": "no epoch"})
                         return self._json(200, {"data": {
                             "epoch": epoch, "participation": part[0]}})
+                    if url.path.startswith("/v1/blockprint/blocks/"):
+                        slot = int(url.path.rsplit("/", 1)[1])
+                        guess = mon.blockprint_block(slot)
+                        if guess is None:
+                            return self._json(404, {"message": "no block"})
+                        return self._json(200, {"data": {
+                            "slot": slot, "best_guess_single": guess}})
+                    if url.path == "/v1/blockprint/diversity":
+                        return self._json(
+                            200, {"data": mon.blockprint_diversity()})
                     if url.path == "/v1/slots/missed":
                         return self._json(200, {"data": mon.missed_slots(
                             int(q["start"][0]), int(q["end"][0]))})

@@ -26,7 +26,7 @@ def test_corpus_exists_and_is_big_enough():
     n_cases = sum(1 for p in CORPUS.rglob("*")
                   if p.is_dir() and (list(p.glob("*.yaml"))
                                      or list(p.glob("*.ssz_snappy"))))
-    assert n_cases >= 50, f"only {n_cases} vector cases committed"
+    assert n_cases >= 80, f"only {n_cases} vector cases committed"
 
 
 def test_all_vectors_pass_with_no_skipped_files():
@@ -38,7 +38,7 @@ def test_all_vectors_pass_with_no_skipped_files():
     runners = {r.path.split("/")[2] for r in ran}
     assert {"ssz_static", "operations", "epoch_processing", "sanity",
             "bls", "fork_choice"} <= runners
-    assert len(ran) >= 50
+    assert len(ran) >= 80
     # OUR corpus must exercise only implemented handlers: no skips at all
     skipped = [r for r in results if r.skipped]
     assert not skipped, "\n".join(f"{r.path}: {r.error}" for r in skipped)

@@ -69,6 +69,17 @@ def test_watch_monitor():
     assert mon.missed_slots(1, 16) == []
     part = mon.participation(h.chain.head().head_state.previous_epoch())
     assert part is not None and part[0] > 0.9
+    # blockprint: harness blocks carry empty graffiti -> Unknown; the
+    # classifier itself fingerprints client strings
+    from lighthouse_tpu.watch.monitor import classify_graffiti
+    assert classify_graffiti(b"Lighthouse/v4.5.0") == "Lighthouse"
+    assert classify_graffiti(b"lighthouse_tpu/r2") == "LighthouseTpu"
+    assert classify_graffiti(b"teku/23.10") == "Teku"
+    assert classify_graffiti(b"\x00" * 32) == "Unknown"
+    div = mon.blockprint_diversity()
+    assert div and div[0]["client"] == "Unknown"
+    assert abs(sum(d["share"] for d in div) - 1.0) < 1e-9
+    assert mon.blockprint_block(1) == "Unknown"
 
 
 def test_eip2386_wallet_roundtrip(tmp_path):
@@ -167,6 +178,10 @@ def test_watch_http_server_and_metrics_timers():
         assert top and top[0]["blocks"] >= 1
         missed = get("/v1/slots/missed?start=1&end=8")["data"]
         assert missed == []
+        bp = get(f"/v1/blockprint/blocks/{rows[0]['slot']}")["data"]
+        assert bp["best_guess_single"]
+        div = get("/v1/blockprint/diversity")["data"]
+        assert div and div[0]["blocks"] >= 1
     finally:
         srv.stop()
     # hot-path timers recorded through the live metrics module
