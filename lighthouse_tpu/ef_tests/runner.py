@@ -30,10 +30,12 @@ FORK_DIRS = {
     "phase0": ForkName.PHASE0, "altair": ForkName.ALTAIR,
     "bellatrix": ForkName.BELLATRIX, "capella": ForkName.CAPELLA,
     "deneb": ForkName.DENEB, "electra": ForkName.ELECTRA,
-    # fulu (PeerDAS cells kzg) has no state forks here yet; kzg cases
-    # are fork-agnostic, so map it to the newest implemented fork
+    # fulu state containers are not implemented; ONLY its fork-agnostic
+    # kzg (cells) runner is executed — every other fulu runner is a
+    # declared skip (see _run_all)
     "fulu": ForkName.ELECTRA,
 }
+FULU_RUNNERS = {"kzg"}
 
 
 @dataclass
@@ -111,6 +113,15 @@ class EfTestRunner:
                 if fork is None:
                     continue
                 for runner_dir in sorted(fork_dir.iterdir()):
+                    if fork_dir.name == "fulu" and \
+                            runner_dir.name not in FULU_RUNNERS:
+                        for case_dir in runner_dir.glob("*/*/*"):
+                            results.append(CaseResult(
+                                str(case_dir.relative_to(self.root)),
+                                ok=True, skipped=True,
+                                error="fulu state containers not "
+                                      "implemented"))
+                        continue
                     results += self._run_runner(spec, fork, runner_dir)
         return results
 

@@ -5,7 +5,10 @@ This engine implements the gossipsub v1.1 mechanics the reference vendors
 (lighthouse_network/gossipsub/src/behaviour.rs): per-topic MESH of degree
 D (GRAFT/PRUNE with prune-backoff), lazy gossip (IHAVE windows over a
 message cache + IWANT pulls), subscription tracking, and validation
-results feeding peer scores (accept/ignore/reject -> PeerManager).
+results feeding peer scores (accept/ignore/reject -> PeerManager) —
+plus v1.2 IDONTWANT (the feature the reference's vendored fork exists
+for): on receiving a large message, mesh peers are told not to forward
+us their copy, cutting duplicate bandwidth for blocks/blobs.
 Delivery is O(mesh degree), not O(peers).
 
 Wire (inside one AEAD transport frame, kind=1):
@@ -13,7 +16,7 @@ Wire (inside one AEAD transport frame, kind=1):
     DATA:        [u8 tlen][topic][4B fork_digest][raw-snappy payload]
     SUB/UNSUB/GRAFT/PRUNE: [u8 tlen][topic]
     IHAVE:       [u8 tlen][topic][u16 n][20B mid]*n
-    IWANT:       [u16 n][20B mid]*n
+    IWANT/IDONTWANT: [u16 n][20B mid]*n
 
 Topics mirror lighthouse_network/src/types/topics.rs:109.  Message ids
 are sha256(fork_digest || topic || data)[:20] (gossipsub v1.1 style).
@@ -54,8 +57,8 @@ class Topic:
         return f"data_column_sidecar_{subnet}"
 
 
-MSG_DATA, MSG_SUB, MSG_UNSUB, MSG_GRAFT, MSG_PRUNE, MSG_IHAVE, MSG_IWANT = \
-    range(7)
+(MSG_DATA, MSG_SUB, MSG_UNSUB, MSG_GRAFT, MSG_PRUNE, MSG_IHAVE, MSG_IWANT,
+ MSG_IDONTWANT) = range(8)
 
 
 def _enc_topic(topic: str) -> bytes:
@@ -82,6 +85,10 @@ class GossipEngine:
     PRUNE_BACKOFF = 60.0
     MAX_IHAVE_PER_MSG = 64
     MAX_PAYLOAD = 10 * 1024 * 1024
+    #: messages at least this large trigger IDONTWANT to mesh peers
+    #: (gossipsub v1.2: only worth the control traffic for big payloads)
+    IDONTWANT_THRESHOLD = 4 * 1024
+    MAX_DONTWANT_PER_PEER = 256
 
     def __init__(self, transport, fork_digest: bytes):
         self.transport = transport
@@ -100,6 +107,10 @@ class GossipEngine:
         self._windows: list[set[bytes]] = [set()]
         self._iwant_budget: dict[str, int] = {}
         self._iwant_served: dict[str, set[bytes]] = {}
+        # peer -> {mid: heartbeat count at receipt}: mids that peer told
+        # us NOT to forward to it (v1.2)
+        self._dontwant: dict[str, OrderedDict[bytes, int]] = {}
+        self._hb_count = 0
         self._lock = threading.Lock()
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -123,6 +134,7 @@ class GossipEngine:
     def on_peer_disconnected(self, node_id: str) -> None:
         with self._lock:
             self.peer_topics.pop(node_id, None)
+            self._dontwant.pop(node_id, None)
             for members in self.mesh.values():
                 members.discard(node_id)
 
@@ -181,6 +193,10 @@ class GossipEngine:
                 members = {pid for pid, tps in self.peer_topics.items()
                            if topic in tps}
                 members = set(self._sample(members, self.D))
+            # v1.2: honor IDONTWANT — peers that already have the message
+            # asked us not to send a duplicate
+            members = {pid for pid in members
+                       if mid not in self._dontwant.get(pid, ())}
         sent = 0
         for pid in members:
             if pid == exclude_peer:
@@ -215,6 +231,8 @@ class GossipEngine:
                 self._handle_ihave(peer, body)
             elif kind == MSG_IWANT:
                 self._handle_iwant(peer, body)
+            elif kind == MSG_IDONTWANT:
+                self._handle_idontwant(peer, body)
         except (ValueError, IndexError, struct.error):
             self.on_validation_result(peer, "?", "reject")
 
@@ -231,6 +249,15 @@ class GossipEngine:
         if self._mark_seen(mid):
             return
         self._cache_put(mid, topic, data)
+        if len(data) >= self.IDONTWANT_THRESHOLD:
+            # v1.2: tell the rest of the mesh we have it BEFORE validating,
+            # so duplicates stop flowing while validation runs
+            with self._lock:
+                others = [pid for pid in self.mesh.get(topic, ())
+                          if pid != peer.node_id]
+            body = struct.pack("<H", 1) + mid
+            for pid in others:
+                self._send_id(pid, MSG_IDONTWANT, body)
         result, ctx = self.validator(topic, data)
         self.on_validation_result(peer, topic, result)
         if result == "accept":
@@ -289,6 +316,18 @@ class GossipEngine:
                 topic, data = entry
             self._send(peer, None, self._data_frame(topic, data),
                        raw=True)
+
+    def _handle_idontwant(self, peer, body: bytes) -> None:
+        """v1.2: record mids the peer does not want forwarded (bounded
+        per peer; entries age out with the mcache windows)."""
+        (n,) = struct.unpack_from("<H", body, 0)
+        n = min(n, self.MAX_IHAVE_PER_MSG)
+        with self._lock:
+            dw = self._dontwant.setdefault(peer.node_id, OrderedDict())
+            for i in range(n):
+                dw[body[2 + 20 * i:2 + 20 * (i + 1)]] = self._hb_count
+                while len(dw) > self.MAX_DONTWANT_PER_PEER:
+                    dw.popitem(last=False)
 
     # -- heartbeat -----------------------------------------------------------
 
@@ -351,6 +390,18 @@ class GossipEngine:
                         if len(self._windows) > self.MCACHE_WINDOWS
                         else set()):
                 self._mcache.pop(mid, None)
+            # IDONTWANT entries age out by heartbeat count, NOT mcache
+            # membership: the entries that matter are exactly the ones for
+            # messages we have not received yet (pre-receipt suppression),
+            # which are never in our mcache
+            self._hb_count += 1
+            horizon = self._hb_count - self.MCACHE_WINDOWS
+            for pid in list(self._dontwant):
+                dw = self._dontwant[pid]
+                while dw and next(iter(dw.values())) < horizon:
+                    dw.popitem(last=False)
+                if not dw:
+                    del self._dontwant[pid]
         for pid, topic in plans_graft:
             self._send_id(pid, MSG_GRAFT, _enc_topic(topic))
         for pid, topic in plans_prune:

@@ -187,3 +187,55 @@ def test_gossip_payloads_are_snappy_not_json():
         assert snappy.decompress_block(body) == b"\x07" * 100
     finally:
         n1.stop()
+
+
+def test_idontwant_suppresses_duplicate_forwarding():
+    """gossipsub v1.2: a large message triggers IDONTWANT to the OTHER
+    mesh peers (not the sender), and recorded entries suppress duplicate
+    forwarding until they age out with the mcache."""
+    from collections import OrderedDict
+    nodes = [Node() for _ in range(3)]
+    a, b, c = nodes
+    topic = Topic.BLOCK
+    for n in nodes:
+        n.engine.subscribe(topic)
+    try:
+        # full mesh of 3
+        assert a.transport.dial("127.0.0.1", b.transport.port)
+        assert a.transport.dial("127.0.0.1", c.transport.port)
+        assert b.transport.dial("127.0.0.1", c.transport.port)
+        assert _wait(lambda: all(len(n.transport.peers) == 2
+                                 for n in nodes))
+        assert _wait(lambda: all(
+            sum(1 for tps in n.engine.peer_topics.values()
+                if topic in tps) == 2 for n in nodes))
+        for n in nodes:
+            n.engine.heartbeat()
+        b_id = b.transport.node_id
+        c_id = c.transport.node_id
+        big = b"\xab" * (GossipEngine.IDONTWANT_THRESHOLD + 100)
+        mid = a.engine._message_id(topic, big)
+        a.engine.publish(topic, big)
+        assert _wait(lambda: b.received and c.received)
+        # B announced IDONTWANT to C and vice versa (never to the sender)
+        assert _wait(lambda: mid in c.engine._dontwant.get(b_id, {}))
+        assert _wait(lambda: mid in b.engine._dontwant.get(c_id, {}))
+        a_id = a.transport.node_id
+        assert mid not in b.engine._dontwant.get(a_id, {})
+        # a peer with a recorded IDONTWANT is skipped on publish
+        before = len(b.received)
+        sent = c.engine.publish(topic, big)   # only A+B in C's mesh; B opted out
+        assert sent <= 1   # at most A (who will drop it as seen)
+        # small messages do NOT trigger IDONTWANT
+        small = b"\x01" * 64
+        a.engine.publish(topic, small)
+        assert _wait(lambda: (topic, small) in b.received)
+        small_mid = a.engine._message_id(topic, small)
+        assert small_mid not in c.engine._dontwant.get(b_id, {})
+        # entries age out with the mcache windows
+        for _ in range(GossipEngine.MCACHE_WINDOWS + 1):
+            c.engine.heartbeat()
+        assert mid not in c.engine._dontwant.get(b_id, {})
+    finally:
+        for n in nodes:
+            n.stop()
