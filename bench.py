@@ -61,6 +61,7 @@ def build_state_columns(n):
     vr._dirty = True
     vr._root_cache = None
     vr._device_leaves = None
+    vr._device_tree = None
     vr._dirty_rows = None
     balances = rng.integers(31 * 10**9, 33 * 10**9, size=n, dtype=np.uint64)
     return vr, balances

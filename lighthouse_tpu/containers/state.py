@@ -118,10 +118,11 @@ class ValidatorRegistry:
         self.withdrawable_epoch = np.zeros(n, dtype=np.uint64)
         self._dirty = True
         self._root_cache: bytes | None = None
-        # device-resident leaf-word cache (the milhouse-style dirty-leaf
-        # tracking): None = rebuild everything; a set = only those
-        # validator rows need re-encoding + scatter
-        self._device_leaves = None
+        # device-resident incremental merkle tree (ops/merkle_tree): None =
+        # rebuild everything; _dirty_rows tracks which validator rows need
+        # re-encoding + a dirty-path rehash (milhouse-style O(diff) root)
+        self._device_leaves = None   # legacy slot, kept for test/bench resets
+        self._device_tree = None
         self._dirty_rows: set[int] | None = None
         # host-native twin (SHA-NI path when no accelerator is attached):
         # incremental merkle tree, shared copy-on-write across copies
@@ -201,9 +202,12 @@ class ValidatorRegistry:
             setattr(out, c, getattr(self, c).copy())
         out._dirty = self._dirty
         out._root_cache = self._root_cache
-        # the device cache is immutable (jax arrays) — share it; dirty-row
-        # sets must not be shared
-        out._device_leaves = self._device_leaves
+        # share the device tree, flagged so the next update on either copy
+        # runs the non-donating program (donation would free buffers the
+        # other copy still references); dirty-row sets must not be shared
+        out._device_leaves = None
+        out._device_tree = (self._device_tree.share()
+                            if self._device_tree is not None else None)
         out._dirty_rows = (set(self._dirty_rows)
                            if self._dirty_rows is not None else None)
         # share the host merkle tree copy-on-write: whoever refreshes
@@ -223,23 +227,22 @@ class ValidatorRegistry:
                              dtype=">u4").reshape(n, 2).astype(np.uint32)
 
     def validator_leaf_words(self, rows: np.ndarray | None = None
-                             ) -> np.ndarray:
-        """u32[R*8, 8]: the 8 field chunks per validator (pubkey pre-hashed),
-        for all validators or a row subset."""
-        from ..ops import sha256 as k
-
+                             ) -> tuple[np.ndarray, np.ndarray]:
+        """(chunks u32[R*8, 8], pk_blocks u32[R, 16]): the 8 field chunks
+        per validator with chunk 0 left zero, plus the 64-byte pubkey
+        block whose hash fills it — hashed on DEVICE inside the fused
+        tree program (ops/merkle_tree, with_pk=True), so no host<->device
+        round trip per update."""
         def col(a):
             return a if rows is None else a[rows]
 
         n = len(self) if rows is None else len(rows)
-        # pubkey root: hash64 of pubkey(48) || zeros(16)
+        # pubkey root preimage: pubkey(48) || zeros(16) as one 64B block
         pk_blocks = np.zeros((n, 64), dtype=np.uint8)
         pk_blocks[:, :48] = col(self.pubkeys)
         pk_words = np.frombuffer(pk_blocks.tobytes(), dtype=">u4").reshape(
             n, 16).astype(np.uint32)
-        pk_roots = np.asarray(k.hash64(pk_words))
         chunks = np.zeros((n, 8, 8), dtype=np.uint32)
-        chunks[:, 0] = pk_roots
         chunks[:, 1] = np.frombuffer(
             np.ascontiguousarray(col(self.withdrawal_credentials)).tobytes(),
             dtype=">u4").reshape(n, 8).astype(np.uint32)
@@ -255,7 +258,7 @@ class ValidatorRegistry:
         chunks[:, 5, :2] = u64w(self.activation_epoch)
         chunks[:, 6, :2] = u64w(self.exit_epoch)
         chunks[:, 7, :2] = u64w(self.withdrawable_epoch)
-        return chunks.reshape(n * 8, 8)
+        return chunks.reshape(n * 8, 8), pk_words
 
     def validator_leaf_bytes(self, rows: np.ndarray | None = None
                              ) -> np.ndarray:
@@ -319,34 +322,29 @@ class ValidatorRegistry:
             rows.sort()
             self._host_tree.update(rows, self._validator_roots(rows))
         self._dirty_rows = set()
-        self._device_leaves = None   # consumed the dirty set
+        self._device_tree = None     # consumed the dirty set
         return mix_in_length(self._host_tree.root(), n)
 
-    def _refresh_device_leaves(self):
-        """Keep u32[N*8, 8] leaf words device-resident; re-encode + scatter
-        only dirty rows (milhouse-style O(diff) updates; the steady-state
-        1M-validator rehash then moves no column data host->device)."""
-        from ..ops import sha256 as k
-        import jax.numpy as jnp
+    def _device_root_words(self, registry_limit: int):
+        """Incremental device tree root: full build when the tree is stale
+        (size change / wholesale mutation), else a fused dirty-path update
+        (ops/merkle_tree.DeviceTree: scatter + O(dirty * depth) rehash +
+        zero caps in ONE compiled program)."""
+        from ..ops.merkle_tree import DeviceTree
         n = len(self)
-        full = (self._device_leaves is None or self._dirty_rows is None
-                or int(self._device_leaves.shape[0]) != n * 8)
-        if full:
-            self._device_leaves = k.jnp_asarray(self.validator_leaf_words())
+        tree = self._device_tree
+        if tree is None or self._dirty_rows is None or tree.n != n:
+            tree = DeviceTree(n, registry_limit, pre_levels=3, with_pk=True)
+            chunks, pk = self.validator_leaf_words()
+            tree.build(chunks, pk)
+            self._device_tree = tree
         elif self._dirty_rows:
             rows = np.fromiter(self._dirty_rows, dtype=np.int64)
-            # pad to a power of two with repeats of rows[0] (idempotent
-            # scatter) to bound the number of compiled shapes
-            target = 1 << (len(rows) - 1).bit_length()
-            if target != len(rows):
-                rows = np.concatenate(
-                    [rows, np.full(target - len(rows), rows[0])])
-            words = self.validator_leaf_words(rows)  # [R*8, 8]
-            flat = (rows[:, None] * 8 + np.arange(8)).reshape(-1)
-            self._device_leaves = self._device_leaves.at[
-                jnp.asarray(flat)].set(k.jnp_asarray(words))
+            rows.sort()
+            chunks, pk = self.validator_leaf_words(rows)
+            tree.update(rows, chunks, pk)
         self._dirty_rows = set()
-        return self._device_leaves
+        return tree.root_words
 
     def hash_tree_root(self, registry_limit: int) -> bytes:
         if not self._dirty and self._root_cache is not None:
@@ -362,10 +360,7 @@ class ValidatorRegistry:
         elif _use_host_hash():
             root = self._host_tree_root(registry_limit)
         else:
-            nodes = self._refresh_device_leaves()
-            for _ in range(3):  # 8 field chunks -> 1 root per validator
-                nodes = k.hash_pairs(nodes)
-            root_words = k.merkleize_words(nodes, registry_limit)
+            root_words = self._device_root_words(registry_limit)
             root = mix_in_length(
                 k.words_to_chunks(np.asarray(root_words)), n)
         self._root_cache = root
@@ -435,7 +430,8 @@ class BalancesColumn:
 
     def __init__(self, values: np.ndarray):
         self.values = np.ascontiguousarray(values, dtype=np.uint64)
-        self._device_leaves = None
+        self._device_leaves = None   # legacy slot, kept for test/bench resets
+        self._device_tree = None
         self._dirty_chunks: set[int] | None = None  # None = full rebuild
         self._root_cache: bytes | None = None
 
@@ -482,27 +478,22 @@ class BalancesColumn:
         self._root_cache = None
         self._dirty_chunks = None
 
-    def _refresh_device_leaves(self):
-        from ..ops import sha256 as k
-        import jax.numpy as jnp
+    def _device_root_words(self, limit_chunks: int):
+        """Incremental device tree root over the packed-u64 chunk leaves
+        (same fused build/update programs as the validator registry)."""
+        from ..ops.merkle_tree import DeviceTree
         n_chunks = (len(self) + 3) // 4
-        full = (self._device_leaves is None or self._dirty_chunks is None
-                or int(self._device_leaves.shape[0]) != n_chunks)
-        if full:
-            self._device_leaves = k.jnp_asarray(self._chunk_words())
+        tree = self._device_tree
+        if tree is None or self._dirty_chunks is None or tree.n != n_chunks:
+            tree = DeviceTree(n_chunks, limit_chunks)
+            tree.build(self._chunk_words())
+            self._device_tree = tree
         elif self._dirty_chunks:
-            chunks = np.fromiter(self._dirty_chunks, dtype=np.int64)
-            # pad to a power of two (idempotent scatter) to bound the
-            # number of compiled scatter shapes
-            target = 1 << (len(chunks) - 1).bit_length()
-            if target != len(chunks):
-                chunks = np.concatenate(
-                    [chunks, np.full(target - len(chunks), chunks[0])])
-            words = self._chunk_words(chunks)
-            self._device_leaves = self._device_leaves.at[
-                jnp.asarray(chunks)].set(k.jnp_asarray(words))
+            idx = np.fromiter(self._dirty_chunks, dtype=np.int64)
+            idx.sort()
+            tree.update(idx, self._chunk_words(idx))
         self._dirty_chunks = set()
-        return self._device_leaves
+        return tree.root_words
 
     def hash_tree_root(self, registry_limit: int) -> bytes:
         if self._root_cache is not None:
@@ -526,11 +517,10 @@ class BalancesColumn:
                 idx.sort()
                 self._host_tree.update(idx, self._chunk_bytes(idx))
             self._dirty_chunks = set()
-            self._device_leaves = None
+            self._device_tree = None
             root = mix_in_length(self._host_tree.root(), n)
         else:
-            leaves = self._refresh_device_leaves()
-            root_words = k.merkleize_words(leaves, limit_chunks)
+            root_words = self._device_root_words(limit_chunks)
             root = mix_in_length(k.words_to_chunks(np.asarray(root_words)), n)
         self._root_cache = root
         return root

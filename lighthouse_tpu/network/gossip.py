@@ -322,6 +322,8 @@ class GossipEngine:
         per peer; entries age out with the mcache windows)."""
         (n,) = struct.unpack_from("<H", body, 0)
         n = min(n, self.MAX_IHAVE_PER_MSG)
+        if len(body) < 2 + 20 * n:
+            raise ValueError("truncated IDONTWANT frame")
         with self._lock:
             dw = self._dontwant.setdefault(peer.node_id, OrderedDict())
             for i in range(n):

@@ -37,6 +37,7 @@ def _registry(n, rng):
     vr._dirty = True
     vr._root_cache = None
     vr._device_leaves = None
+    vr._device_tree = None
     vr._dirty_rows = None
     return vr
 

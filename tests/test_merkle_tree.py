@@ -1,0 +1,108 @@
+"""Fused incremental device merkle tree (ops/merkle_tree.DeviceTree) —
+the milhouse-equivalent O(dirty-path) root used by the 1M-validator
+tree-hash north star (reference: consensus/types/src/beacon_state.rs
+update_tree_hash_cache + milhouse persistent trees)."""
+import numpy as np
+import pytest
+
+from lighthouse_tpu.containers import state as st
+from lighthouse_tpu.ops.merkle_tree import DeviceTree
+from lighthouse_tpu.ops.sha256 import chunks_to_words
+from lighthouse_tpu.ssz import merkleize_chunks
+
+
+def _rand_chunks(rng, n):
+    return rng.integers(0, 256, size=(n, 32), dtype=np.uint8)
+
+
+@pytest.mark.parametrize("n,limit", [(1, 16), (5, 16), (8, 8),
+                                     (100, 2**16), (1000, 2**38)])
+def test_build_matches_ssz_oracle(n, limit):
+    rng = np.random.default_rng(n)
+    chunks = _rand_chunks(rng, n)
+    tree = DeviceTree(n, limit)
+    tree.build(chunks_to_words(chunks.tobytes()))
+    want = merkleize_chunks([bytes(c) for c in chunks], limit)
+    assert tree.root() == want
+
+
+@pytest.mark.parametrize("rows", [[0], [1, 2, 3], [0, 99], [7] * 5])
+def test_update_equals_rebuild(rows):
+    rng = np.random.default_rng(42)
+    n, limit = 100, 2**16
+    chunks = _rand_chunks(rng, n)
+    tree = DeviceTree(n, limit)
+    tree.build(chunks_to_words(chunks.tobytes()))
+    for r in rows:
+        chunks[r] = rng.integers(0, 256, size=32, dtype=np.uint8)
+    tree.update(np.asarray(sorted(set(rows))),
+                chunks_to_words(chunks[sorted(set(rows))].tobytes()))
+    fresh = DeviceTree(n, limit)
+    fresh.build(chunks_to_words(chunks.tobytes()))
+    assert tree.root() == fresh.root()
+
+
+def test_shared_tree_update_preserves_other_copy():
+    rng = np.random.default_rng(7)
+    n, limit = 64, 2**10
+    chunks = _rand_chunks(rng, n)
+    tree = DeviceTree(n, limit)
+    tree.build(chunks_to_words(chunks.tobytes()))
+    root0 = tree.root()
+    other = tree.share()   # second owner of the same buffers
+    levels_before = other.levels
+    chunks[3] = 0
+    tree.update(np.asarray([3]), chunks_to_words(chunks[3:4].tobytes()))
+    assert tree.root() != root0
+    # the shared buffers were not donated: still materializable
+    np.asarray(levels_before[0])
+    fresh = DeviceTree(n, limit)
+    fresh.build(chunks_to_words(chunks.tobytes()))
+    assert tree.root() == fresh.root()
+
+
+def test_registry_device_incremental_matches_rebuild():
+    rng = np.random.default_rng(11)
+    n = 300
+    vr = st.ValidatorRegistry(n)
+    vr.pubkeys = rng.integers(0, 256, size=(n, 48), dtype=np.uint8)
+    vr.withdrawal_credentials = rng.integers(0, 256, size=(n, 32),
+                                             dtype=np.uint8)
+    vr.effective_balance = rng.integers(0, 2**40, size=n, dtype=np.uint64)
+    old = st._USE_HOST_HASH
+    st._USE_HOST_HASH = False
+    try:
+        limit = 2**40
+        vr.hash_tree_root(limit)
+        assert vr._device_tree is not None
+        for i in (0, 150, 299):
+            vr.set_field(i, "exit_epoch", 42)
+        incremental = vr.hash_tree_root(limit)
+        vr._device_tree = None
+        vr._dirty_rows = None
+        vr._root_cache = None
+        vr._dirty = True
+        assert vr.hash_tree_root(limit) == incremental
+    finally:
+        st._USE_HOST_HASH = old
+
+
+def test_registry_copy_isolated_on_device_path():
+    rng = np.random.default_rng(13)
+    n = 50
+    vr = st.ValidatorRegistry(n)
+    vr.pubkeys = rng.integers(0, 256, size=(n, 48), dtype=np.uint8)
+    old = st._USE_HOST_HASH
+    st._USE_HOST_HASH = False
+    try:
+        limit = 2**40
+        parent_root = vr.hash_tree_root(limit)
+        clone = vr.copy()
+        clone.set_field(0, "effective_balance", 7)
+        clone_root = clone.hash_tree_root(limit)
+        assert clone_root != parent_root
+        vr.set_field(1, "effective_balance", 9)
+        vr.set_field(1, "effective_balance", 0)
+        assert vr.hash_tree_root(limit) == parent_root
+    finally:
+        st._USE_HOST_HASH = old
