@@ -202,6 +202,7 @@ def _try_child(force_cpu, timeout):
         out = e.stdout or b""
         rec = _parse_record(out.decode() if isinstance(out, bytes) else out)
         if rec is not None:
+            rec["salvaged_after_timeout"] = True
             return rec, None
         return None, "timeout after %ds" % timeout
     rec = _parse_record(proc.stdout)
@@ -237,6 +238,23 @@ def main():
     for force_cpu, timeout in budget:
         rec, err = _try_child(force_cpu, timeout)
         if rec is not None:
+            if (not force_cpu and rec.get("platform") not in (None, "cpu")
+                    and not rec.get("salvaged_after_timeout")
+                    and os.environ.get("LHTPU_BENCH", "tree_hash")
+                    == "tree_hash"):
+                # tunnel is alive: best-effort second north star (BLS
+                # batch throughput) merged into the same record
+                os.environ["LHTPU_BENCH"] = "bls"
+                try:
+                    bls_rec, _ = _try_child(False, int(os.environ.get(
+                        "LHTPU_BENCH_BLS_TIMEOUT", 600)))
+                finally:
+                    os.environ["LHTPU_BENCH"] = "tree_hash"
+                if bls_rec is not None and bls_rec.get("value"):
+                    rec["bls_sigs_per_sec"] = bls_rec["value"]
+                    rec["bls_vs_baseline"] = bls_rec["vs_baseline"]
+                    rec["bls_baseline_source"] = \
+                        bls_rec.get("baseline_source")
             print(json.dumps(rec))
             return
         errors.append(("cpu" if force_cpu else "default") + ": " + err)

@@ -344,6 +344,7 @@ class ValidatorRegistry:
             chunks, pk = self.validator_leaf_words(rows)
             tree.update(rows, chunks, pk)
         self._dirty_rows = set()
+        self._host_tree = None       # consumed the dirty set
         return tree.root_words
 
     def hash_tree_root(self, registry_limit: int) -> bytes:
@@ -432,11 +433,31 @@ class BalancesColumn:
         self.values = np.ascontiguousarray(values, dtype=np.uint64)
         self._device_leaves = None   # legacy slot, kept for test/bench resets
         self._device_tree = None
+        self._host_tree = None
+        self._host_shared = False
         self._dirty_chunks: set[int] | None = None  # None = full rebuild
         self._root_cache: bytes | None = None
 
     def __len__(self) -> int:
         return self.values.shape[0]
+
+    def fork(self, values: np.ndarray) -> "BalancesColumn":
+        """A second owner over a copied values array: trees are shared
+        copy-on-write (the host tree clones on next update; the device
+        tree switches to the non-donating program)."""
+        out = BalancesColumn.__new__(BalancesColumn)
+        out.values = np.ascontiguousarray(values, dtype=np.uint64)
+        out._device_leaves = None
+        out._device_tree = (self._device_tree.share()
+                            if self._device_tree is not None else None)
+        out._host_tree = self._host_tree
+        if self._host_tree is not None:
+            self._host_shared = True
+        out._host_shared = self._host_tree is not None
+        out._dirty_chunks = (set(self._dirty_chunks)
+                             if self._dirty_chunks is not None else None)
+        out._root_cache = self._root_cache
+        return out
 
     def _chunk_bytes(self, chunks: np.ndarray | None = None) -> np.ndarray:
         """u8[C, 32] packed-u64 chunk bytes (4 balances per chunk), for
@@ -460,6 +481,13 @@ class BalancesColumn:
         from ..ops import sha256 as k
         return k.chunks_to_words(self._chunk_bytes(chunks).tobytes())
 
+    def mark_dirty(self, i: int) -> None:
+        """Record an already-applied mutation of element ``i`` (the one
+        place the invalidation invariant lives)."""
+        self._root_cache = None
+        if self._dirty_chunks is not None:
+            self._dirty_chunks.add(int(i) // 4)
+
     def set_many(self, rows: np.ndarray, values: np.ndarray) -> None:
         self.values[rows] = values
         self._root_cache = None
@@ -468,9 +496,7 @@ class BalancesColumn:
 
     def set(self, i: int, value: int) -> None:
         self.values[i] = value
-        self._root_cache = None
-        if self._dirty_chunks is not None:
-            self._dirty_chunks.add(int(i) // 4)
+        self.mark_dirty(i)
 
     def replace(self, values: np.ndarray) -> None:
         """Wholesale column replacement (epoch-processing rewards sweep)."""
@@ -493,6 +519,7 @@ class BalancesColumn:
             idx.sort()
             tree.update(idx, self._chunk_words(idx))
         self._dirty_chunks = set()
+        self._host_tree = None       # consumed the dirty set
         return tree.root_words
 
     def hash_tree_root(self, registry_limit: int) -> bytes:
@@ -512,7 +539,11 @@ class BalancesColumn:
                     or tree.n != n_chunks:
                 self._host_tree = nh.HostTree(self._chunk_bytes(),
                                               limit_chunks)
+                self._host_shared = False
             elif self._dirty_chunks:
+                if self._host_shared:
+                    self._host_tree = self._host_tree.copy()
+                    self._host_shared = False
                 idx = np.fromiter(self._dirty_chunks, dtype=np.int64)
                 idx.sort()
                 self._host_tree.update(idx, self._chunk_bytes(idx))
@@ -628,7 +659,27 @@ def active_field_specs(T: Types, fork: ForkName) -> list[FieldSpec]:
 
 
 class BeaconState:
-    """One class for all forks; fields outside the active fork are None."""
+    """One class for all forks; fields outside the active fork are None.
+
+    The balances column carries an incremental tree-hash cache (the
+    update_tree_hash_cache discipline, reference consensus/types/src/
+    beacon_state.rs:2031-2046): point mutations MUST go through
+    ``increase_balance``/``decrease_balance`` (state_transition/helpers)
+    or call ``mark_balances_dirty``; wholesale rebinds
+    (``state.balances = arr``) are caught by ``__setattr__`` and trigger
+    a full rebuild."""
+
+    _balances_cache: "BalancesColumn | None" = None
+
+    def __setattr__(self, name, value):
+        if name == "balances":
+            object.__setattr__(self, "_balances_cache", None)
+        object.__setattr__(self, name, value)
+
+    def mark_balances_dirty(self, index: int) -> None:
+        cache = self._balances_cache
+        if cache is not None:
+            cache.mark_dirty(index)
 
     def __init__(self, T: Types, spec: ChainSpec, fork_name: ForkName):
         self.T = T
@@ -771,6 +822,10 @@ class BeaconState:
         for f in state_field_specs(self.T):
             if not hasattr(out, f.name):
                 setattr(out, f.name, None)
+        # share the balances tree cache copy-on-write over the copied array
+        if self._balances_cache is not None:
+            object.__setattr__(out, "_balances_cache",
+                               self._balances_cache.fork(out.balances))
         return out
 
     # -- merkleization -------------------------------------------------------
@@ -792,6 +847,12 @@ class BeaconState:
         if f.kind == "u64_vec":
             return _np_uint_root(v, (f.limit * 8 + 31) // 32)
         if f.kind == "u64_list":
+            if f.name == "balances" and len(v):
+                cache = self._balances_cache
+                if cache is None or cache.values is not v:
+                    cache = BalancesColumn(v)
+                    object.__setattr__(self, "_balances_cache", cache)
+                return cache.hash_tree_root(f.limit)
             return _np_uint_root(v, (f.limit * 8 + 31) // 32, length=len(v))
         if f.kind == "u8_list":
             return _np_uint_root(v, (f.limit + 31) // 32, length=len(v))

@@ -507,7 +507,11 @@ def miller_loop_batch(px, py, qx, qy):
     n = px.shape[0]
     two_inv = jnp.asarray(_TWO_INV)
     f = fp12_one_like((n,))
-    tx, ty, tz = qx, qy, jnp.broadcast_to(jnp.asarray(FP2_ONE), qx.shape)
+    # tie the scan carry's device-varying type to the inputs (shard_map
+    # vma: a constant-one carry would mismatch the varying loop state)
+    f = f + (px[:, None, None, None, :] & jnp.int32(0))
+    tx, ty = qx, qy
+    tz = jnp.broadcast_to(jnp.asarray(FP2_ONE), qx.shape) + (qx & jnp.int32(0))
 
     bits = jnp.asarray(_X_BITS[1:])
 

@@ -7,3 +7,4 @@ signature-set batches and merkle subtrees across chips with `shard_map` over a
 """
 from .mesh import batch_mesh, shard_batch
 from .merkle import sharded_merkleize, sharded_state_root_step
+from .bls import sharded_pairing_check

@@ -1,0 +1,48 @@
+"""Mesh-sharded BLS multi-pairing.
+
+The reference spreads its RLC batch verification's multi-pairing across
+CPU cores inside blst (crypto/bls/src/impls/blst.rs:37-119,
+block_signature_verifier.rs:413-414).  The TPU-native analog shards the
+(P_i, Q_i) pair batch across the device mesh: each chip runs the Miller
+loop on its shard and reduces it to one local Fp12 product, the n_dev
+partial products are all-gathered over ICI (n_dev * 1.5 KiB — one tiny
+collective), and the shared final exponentiation + identity check runs
+replicated.  Scales the 10k-signature gossip batch linearly in chips
+without touching DCN.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.bls12_381 import (
+    final_exponentiation,
+    fp12_eq,
+    fp12_one_like,
+    fp12_product,
+    miller_loop_batch,
+)
+
+
+def _local_check(px, py, qx, qy, axis: str):
+    fs = miller_loop_batch(px, py, qx, qy)     # [local, 2, 3, 2, 32]
+    local = fp12_product(fs)                   # [2, 3, 2, 32]
+    partials = jax.lax.all_gather(local, axis)  # [n_dev, ...] over ICI
+    out = final_exponentiation(fp12_product(partials))
+    return fp12_eq(out[None], fp12_one_like((1,)))  # [1] bool, replicated
+
+
+def sharded_pairing_check(mesh: Mesh, px, py, qx, qy,
+                          axis: str = "batch"):
+    """prod_i e(P_i, Q_i) == 1 with the pair batch row-sharded over the
+    mesh.  The batch size must divide evenly across mesh[axis]."""
+    fn = shard_map(
+        functools.partial(_local_check, axis=axis),
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+    )
+    return jax.jit(fn)(px, py, qx, qy)[0]

@@ -626,6 +626,7 @@ def _queue_excess_active_balance(state: BeaconState, index: int) -> None:
     if balance > p.min_activation_balance:
         excess = balance - p.min_activation_balance
         state.balances[index] = p.min_activation_balance
+        state.mark_balances_dirty(index)
         v = state.validators.view(index)
         state.pending_deposits.append(state.T.PendingDeposit(
             pubkey=v.pubkey, withdrawal_credentials=v.withdrawal_credentials,

@@ -81,11 +81,13 @@ def get_total_active_balance(state: BeaconState) -> int:
 
 def increase_balance(state: BeaconState, index: int, delta: int) -> None:
     state.balances[index] = int(state.balances[index]) + delta
+    state.mark_balances_dirty(index)
 
 
 def decrease_balance(state: BeaconState, index: int, delta: int) -> None:
     cur = int(state.balances[index])
     state.balances[index] = 0 if delta > cur else cur - delta
+    state.mark_balances_dirty(index)
 
 
 def latest_block_header_root(state: BeaconState) -> bytes:

@@ -100,6 +100,7 @@ def upgrade_to_electra(state: BeaconState) -> None:
         i = int(i)
         balance = int(state.balances[i])
         state.balances[i] = 0
+        state.mark_balances_dirty(i)
         v.set_field(i, "effective_balance", 0)
         v.set_field(i, "activation_eligibility_epoch", FAR_FUTURE_EPOCH)
         view = v.view(i)

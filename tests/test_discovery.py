@@ -63,8 +63,13 @@ def test_discv5_mesh_sessions_and_subnet_predicates():
             n.start()
         for n in nodes:
             n.bootstrap()
-        for n in nodes:
-            n.lookup()
+        # UDP under CI load can drop packets — retry lookups until the
+        # tables fill (the protocol is idempotent)
+        for _ in range(10):
+            for n in nodes:
+                n.lookup()
+            if all(len(n.table) >= 3 for n in nodes):
+                break
         assert all(len(n.table) >= 3 for n in nodes), \
             [len(n.table) for n in nodes]
         # liveness

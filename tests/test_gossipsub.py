@@ -17,7 +17,7 @@ from lighthouse_tpu.network.transport import Transport
 from lighthouse_tpu.network import snappy
 
 
-def _wait(cond, timeout=5.0):
+def _wait(cond, timeout=15.0):
     t0 = time.time()
     while time.time() - t0 < timeout:
         if cond():

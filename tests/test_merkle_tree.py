@@ -106,3 +106,35 @@ def test_registry_copy_isolated_on_device_path():
         assert vr.hash_tree_root(limit) == parent_root
     finally:
         st._USE_HOST_HASH = old
+
+
+def _fresh_root(state):
+    object.__setattr__(state, "_balances_cache", None)
+    return state.hash_tree_root()
+
+
+def test_beacon_state_balances_cache_consistency():
+    from lighthouse_tpu.specs import minimal_spec
+    from lighthouse_tpu.state_transition import helpers
+    from lighthouse_tpu.state_transition.genesis import interop_genesis_state
+    spec = minimal_spec()
+    state = interop_genesis_state(spec, list(range(1, 17)), genesis_time=0)
+    r0 = state.hash_tree_root()
+    assert state._balances_cache is not None
+    # point mutations through the funnels
+    helpers.increase_balance(state, 3, 17)
+    helpers.decrease_balance(state, 7, 10**18)   # saturates at 0
+    cached = state.hash_tree_root()
+    assert cached == _fresh_root(state)
+    assert cached != r0
+    # copy-on-write isolation
+    clone = state.copy()
+    helpers.increase_balance(clone, 0, 5)
+    clone_root = clone.hash_tree_root()
+    assert clone_root != cached
+    assert state.hash_tree_root() == cached
+    assert clone_root == _fresh_root(clone)
+    # wholesale rebind (epoch rewards sweep shape)
+    state.balances = state.balances + np.uint64(1)
+    rebind_root = state.hash_tree_root()
+    assert rebind_root == _fresh_root(state)

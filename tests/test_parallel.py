@@ -1,4 +1,5 @@
 """Mesh-sharded merkleization on the virtual 8-device CPU mesh."""
+import pytest
 import numpy as np
 
 import jax
@@ -36,3 +37,51 @@ def test_sharded_state_root_step():
         np.asarray(k.merkleize_words(np.asarray(v), 512)))
     assert k.words_to_chunks(np.asarray(br)) == k.words_to_chunks(
         np.asarray(k.merkleize_words(np.asarray(b), 64)))
+
+
+@pytest.mark.skipif("not __import__('os').environ.get('LHTPU_SLOW_TESTS')")
+def test_sharded_pairing_check_matches_single_device():
+    import numpy as np
+    import lighthouse_tpu.ops.bls12_381 as k
+    from lighthouse_tpu.crypto.bls12_381 import (
+        G1_GENERATOR, hash_to_g2, keygen_interop, sign, sk_to_pk,
+    )
+    from lighthouse_tpu.parallel import batch_mesh, sharded_pairing_check
+
+    # 8 pairs = 4 signature checks: e(-g1, sig) * e(pk, H(msg)) == 1
+    g1s, g2s = [], []
+    for i in range(4):
+        sk = keygen_interop(i + 1)
+        msg = bytes([i]) * 32
+        g1s += [G1_GENERATOR.neg(), sk_to_pk(sk)]
+        g2s += [sign(sk, msg), hash_to_g2(msg)]
+    px, py = _encode_g1(g1s)
+    qx, qy = _encode_g2(g2s)
+    mesh = batch_mesh(8)
+    ok = sharded_pairing_check(mesh, px, py, qx, qy)
+    assert bool(np.asarray(ok))
+    assert bool(np.asarray(k.pairing_check_batch(px, py, qx, qy)))
+    # corrupt one pairing -> sharded check fails
+    g2s[1] = hash_to_g2(b"\xff" * 32)
+    qx2, qy2 = _encode_g2(g2s)
+    assert not bool(np.asarray(sharded_pairing_check(mesh, px, py, qx2, qy2)))
+
+
+def _encode_g1(points):
+    import lighthouse_tpu.ops.bls12_381 as k
+    xs, ys = [], []
+    for p in points:
+        x, y = p.to_affine()
+        xs.append(int(x))
+        ys.append(int(y))
+    return k.fp_encode(xs), k.fp_encode(ys)
+
+
+def _encode_g2(points):
+    import lighthouse_tpu.ops.bls12_381 as k
+    xs, ys = [], []
+    for p in points:
+        x, y = p.to_affine()
+        xs.append(x)
+        ys.append(y)
+    return k.fp2_encode(xs), k.fp2_encode(ys)
