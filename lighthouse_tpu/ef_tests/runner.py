@@ -247,6 +247,12 @@ _OP_FILES = {
     "sync_aggregate": ("sync_aggregate.ssz_snappy", "SyncAggregate"),
     "bls_to_execution_change": ("address_change.ssz_snappy",
                                 "SignedBLSToExecutionChange"),
+    "withdrawals": ("execution_payload.ssz_snappy", "ExecutionPayload"),
+    "deposit_request": ("deposit_request.ssz_snappy", "DepositRequest"),
+    "withdrawal_request": ("withdrawal_request.ssz_snappy",
+                           "WithdrawalRequest"),
+    "consolidation_request": ("consolidation_request.ssz_snappy",
+                              "ConsolidationRequest"),
 }
 
 
@@ -261,10 +267,13 @@ def _h_operations(spec, fork, handler, case: _Case) -> None:
     fname, tname = _OP_FILES[handler]
     T = _types(spec)
     pre = _load_state(spec, fork, case, "pre.ssz_snappy")
-    if tname == "SignedBLSToExecutionChange":
-        cls = getattr(T, "SignedBLSToExecutionChange", None)
+    if tname in ("SignedBLSToExecutionChange", "DepositRequest",
+                 "WithdrawalRequest", "ConsolidationRequest"):
+        cls = getattr(T, tname, None)
         if cls is None:
-            raise _DeclaredSkip("no SignedBLSToExecutionChange type")
+            raise _DeclaredSkip(f"no {tname} type")
+    elif tname == "ExecutionPayload":
+        cls = T.ExecutionPayload[fork]
     else:
         cls = _ssz_type_for(T, fork, tname)
     op = deserialize(cls.ssz_type, case.read_ssz(fname))
@@ -287,6 +296,14 @@ def _h_operations(spec, fork, handler, case: _Case) -> None:
             blk.process_sync_aggregate(pre, op, pre.slot, vs)
         elif handler == "bls_to_execution_change":
             blk.process_bls_to_execution_change(pre, op, vs)
+        elif handler == "withdrawals":
+            blk.process_withdrawals(pre, op)
+        elif handler == "deposit_request":
+            blk.process_deposit_request(pre, op)
+        elif handler == "withdrawal_request":
+            blk.process_withdrawal_request(pre, op)
+        elif handler == "consolidation_request":
+            blk.process_consolidation_request(pre, op)
 
     if case.has("post.ssz_snappy"):
         apply()
