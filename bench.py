@@ -31,7 +31,8 @@ sys.path.insert(0, _REPO)
 N_VALIDATORS = 1_000_000
 TARGET_MS = 200.0
 
-N_SIGS = 2048
+N_SIGS = 2048          # CPU-fallback batch (fits the child timeout)
+N_SIGS_TPU = 10000     # BASELINE.md config 3: the 10k gossip batch
 # blst on the reference's recommended 4-core node: ~0.38 ms/pairing
 # single-thread => ~8.7k sigs/s across 4 cores on a 10k batch (BASELINE.md);
 # the >=4x target means >= ~35k sigs/s on one chip.  When the native C++
@@ -103,8 +104,15 @@ def bench_bls():
     checks, SSWU hash-to-G2, RLC scaling, n+1 Miller loops, one final
     exponentiation.  Sets are signed by the native C++ backend (fast,
     byte-compatible), so the timed path is exactly
-    attestation_verification's verify_signature_sets."""
-    n = int(os.environ.get("LHTPU_BENCH_NSIGS", N_SIGS))
+    attestation_verification's verify_signature_sets.
+
+    Batch size: BASELINE.md config 3 is a 10k-signature gossip batch; we
+    default to it on an accelerator and fall back to a smaller batch on
+    the CPU-fallback platform so the record still lands inside the child
+    timeout (the JSON carries n_sigs + platform either way)."""
+    import jax
+    default_n = N_SIGS_TPU if jax.default_backend() != "cpu" else N_SIGS
+    n = int(os.environ.get("LHTPU_BENCH_NSIGS", default_n))
     from lighthouse_tpu.crypto import bls
     from lighthouse_tpu.crypto.bls import SignatureSet
     try:
@@ -126,7 +134,7 @@ def bench_bls():
         assert tpu.verify_signature_sets(sets)
         times.append(time.perf_counter() - t0)
     secs = min(times)
-    return n / secs
+    return n / secs, n
 
 
 def _measured_host_baseline():
@@ -150,7 +158,7 @@ def child_main():
     platform = jax.default_backend()
     mode = os.environ.get("LHTPU_BENCH", "tree_hash")
     if mode == "bls":
-        sigs_per_sec = bench_bls()
+        sigs_per_sec, n_sigs = bench_bls()
         baseline, baseline_source = _measured_host_baseline()
         rec = {
             "metric": "bls_batch_verify_throughput",
@@ -160,7 +168,7 @@ def child_main():
             "platform": platform,
             "baseline_sigs_per_sec": round(baseline, 1),
             "baseline_source": baseline_source,
-            "n_sigs": int(os.environ.get("LHTPU_BENCH_NSIGS", N_SIGS)),
+            "n_sigs": n_sigs,
         }
     else:
         ms = bench_tree_hash()
@@ -223,6 +231,27 @@ def _parse_record(stdout: str):
     return None
 
 
+def _bls_record(tree_hash_was_cpu: bool):
+    """Run the BLS child once, on the platform that just worked for the
+    tree-hash record (don't re-risk a wedged tunnel), falling back to
+    forced-CPU when the accelerator attempt yields nothing."""
+    prev = os.environ.get("LHTPU_BENCH")
+    os.environ["LHTPU_BENCH"] = "bls"
+    try:
+        attempts = [True] if tree_hash_was_cpu else [False, True]
+        for force_cpu in attempts:
+            rec, _ = _try_child(force_cpu, int(os.environ.get(
+                "LHTPU_BENCH_BLS_TIMEOUT", 600 if not force_cpu else 1200)))
+            if rec is not None and rec.get("value"):
+                return rec
+        return None
+    finally:
+        if prev is None:
+            del os.environ["LHTPU_BENCH"]
+        else:
+            os.environ["LHTPU_BENCH"] = prev
+
+
 def main():
     if os.environ.get("LHTPU_BENCH_CHILD"):
         return child_main()
@@ -238,21 +267,20 @@ def main():
     for force_cpu, timeout in budget:
         rec, err = _try_child(force_cpu, timeout)
         if rec is not None:
-            if (not force_cpu and rec.get("platform") not in (None, "cpu")
-                    and not rec.get("salvaged_after_timeout")
-                    and os.environ.get("LHTPU_BENCH", "tree_hash")
-                    == "tree_hash"):
-                # tunnel is alive: best-effort second north star (BLS
-                # batch throughput) merged into the same record
-                os.environ["LHTPU_BENCH"] = "bls"
-                try:
-                    bls_rec, _ = _try_child(False, int(os.environ.get(
-                        "LHTPU_BENCH_BLS_TIMEOUT", 600)))
-                finally:
-                    os.environ["LHTPU_BENCH"] = "tree_hash"
+            if (os.environ.get("LHTPU_BENCH", "tree_hash") == "tree_hash"
+                    and not rec.get("salvaged_after_timeout")):
+                # best-effort second north star (BLS batch throughput)
+                # merged into the same record — attempted even when the
+                # tree-hash number came from the CPU fallback (VERDICT r2
+                # weak #1: skipping it left the flagship claim with no
+                # trend line at all); the platform label keeps a CPU
+                # number from masquerading as a TPU one
+                bls_rec = _bls_record(force_cpu)
                 if bls_rec is not None and bls_rec.get("value"):
                     rec["bls_sigs_per_sec"] = bls_rec["value"]
                     rec["bls_vs_baseline"] = bls_rec["vs_baseline"]
+                    rec["bls_platform"] = bls_rec.get("platform")
+                    rec["bls_n_sigs"] = bls_rec.get("n_sigs")
                     rec["bls_baseline_source"] = \
                         bls_rec.get("baseline_source")
             print(json.dumps(rec))

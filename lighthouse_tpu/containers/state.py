@@ -674,6 +674,11 @@ class BeaconState:
     def __setattr__(self, name, value):
         if name == "balances":
             object.__setattr__(self, "_balances_cache", None)
+            # normalize so BalancesColumn(value) aliases rather than
+            # copies — a copy would defeat the `cache.values is v`
+            # freshness check and silently degrade to full rebuilds
+            if isinstance(value, np.ndarray):
+                value = np.ascontiguousarray(value, dtype=np.uint64)
         object.__setattr__(self, name, value)
 
     def mark_balances_dirty(self, index: int) -> None:

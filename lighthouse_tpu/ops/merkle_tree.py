@@ -108,8 +108,9 @@ def _update_fn(dense_depth: int, limit_depth: int, pre_levels: int,
     """One jitted program: (levels, rows, new_pre_leaves[, pk_blocks])
     -> (levels, root).
 
-    rows: i32[R] dirty leaf indices (duplicates allowed — idempotent),
-    new_leaves: u32[R * 2**pre_levels, 8] replacement (pre-)leaf words.
+    rows: i32[R] dirty leaf indices (duplicates allowed only with
+    identical leaf words — distinct values for one row would race in the
+    scatter), new_leaves: u32[R * 2**pre_levels, 8] replacement words.
     """
     import jax
 
@@ -203,11 +204,18 @@ class DeviceTree:
 
     def update(self, rows: np.ndarray, pre_leaf_words,
                pk_blocks=None) -> None:
-        """rows: leaf indices (will be padded to a power of two with
-        idempotent repeats); pre_leaf_words: u32[R * 2**pre_levels, 8]."""
+        """rows: leaf indices; pre_leaf_words: u32[R * 2**pre_levels, 8].
+
+        Duplicate rows are allowed only when they carry identical leaf
+        words (the internal power-of-two padding repeats row[0]); distinct
+        values for the same row would make the scatter nondeterministic.
+        An empty ``rows`` is a no-op.
+        """
         jnp = _jnp()
         rows = np.asarray(rows, dtype=np.int32)
         r = len(rows)
+        if r == 0:
+            return
         target = 1 << (r - 1).bit_length() if r > 1 else 1
         words = np.asarray(pre_leaf_words)
         if target != r:

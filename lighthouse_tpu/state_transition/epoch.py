@@ -172,10 +172,10 @@ def _inactivity_penalty_quotient(p, fork: ForkName) -> int:
     return p.inactivity_penalty_quotient_altair
 
 
-def _process_rewards_and_penalties_altair(state: BeaconState, fork: ForkName,
-                                          total_active: int) -> None:
-    if state.current_epoch() == GENESIS_EPOCH:
-        return
+def altair_flag_deltas(state: BeaconState, total_active: int,
+                       flag_index: int) -> tuple[np.ndarray, np.ndarray]:
+    """Spec get_flag_index_deltas (per-validator rewards/penalties int64
+    arrays) — the EF `rewards` runner's source/target/head components."""
     p = state.T.preset
     inc = p.effective_balance_increment
     eligible = eligible_validator_mask(state)
@@ -185,31 +185,53 @@ def _process_rewards_and_penalties_altair(state: BeaconState, fork: ForkName,
     base_rewards = (eb // inc) * base_per_inc
     active_increments = total_active // inc
     leak = is_in_inactivity_leak(state)
-
+    weight = PARTICIPATION_FLAG_WEIGHTS[flag_index]
     rewards = np.zeros(len(eb), dtype=np.int64)
     penalties = np.zeros(len(eb), dtype=np.int64)
-    prev = state.previous_epoch()
-    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
-        participating = _unslashed_participating_mask(state, flag_index, prev)
-        part_increments = int(eb[participating].sum()) // inc
-        if not leak:
-            reward_num = base_rewards * weight * part_increments
-            rewards += np.where(
-                eligible & participating,
-                reward_num // (active_increments * WEIGHT_DENOMINATOR), 0)
-        if flag_index != TIMELY_HEAD_FLAG_INDEX:
-            penalties += np.where(eligible & ~participating,
-                                  base_rewards * weight // WEIGHT_DENOMINATOR,
-                                  0)
-    # inactivity penalties
+    participating = _unslashed_participating_mask(state, flag_index,
+                                                  state.previous_epoch())
+    part_increments = int(eb[participating].sum()) // inc
+    if not leak:
+        reward_num = base_rewards * weight * part_increments
+        rewards += np.where(
+            eligible & participating,
+            reward_num // (active_increments * WEIGHT_DENOMINATOR), 0)
+    if flag_index != TIMELY_HEAD_FLAG_INDEX:
+        penalties += np.where(eligible & ~participating,
+                              base_rewards * weight // WEIGHT_DENOMINATOR,
+                              0)
+    return rewards, penalties
+
+
+def altair_inactivity_deltas(state: BeaconState, fork: ForkName
+                             ) -> tuple[np.ndarray, np.ndarray]:
+    """Spec get_inactivity_penalty_deltas (rewards always zero)."""
+    p = state.T.preset
+    eligible = eligible_validator_mask(state)
+    eb = state.validators.effective_balance.astype(np.int64)
     target_ok = _unslashed_participating_mask(state, TIMELY_TARGET_FLAG_INDEX,
-                                              prev)
+                                              state.previous_epoch())
     quotient = _inactivity_penalty_quotient(p, fork)
     scores = state.inactivity_scores.astype(np.int64)
-    penalty_num = eb * scores
-    penalties += np.where(
+    penalties = np.where(
         eligible & ~target_ok,
-        penalty_num // (p.inactivity_score_bias * quotient), 0)
+        eb * scores // (p.inactivity_score_bias * quotient), 0)
+    return np.zeros(len(eb), dtype=np.int64), penalties
+
+
+def _process_rewards_and_penalties_altair(state: BeaconState, fork: ForkName,
+                                          total_active: int) -> None:
+    if state.current_epoch() == GENESIS_EPOCH:
+        return
+    rewards = np.zeros(len(state.validators), dtype=np.int64)
+    penalties = np.zeros(len(state.validators), dtype=np.int64)
+    for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS)):
+        r, pen = altair_flag_deltas(state, total_active, flag_index)
+        rewards += r
+        penalties += pen
+    r, pen = altair_inactivity_deltas(state, fork)
+    rewards += r
+    penalties += pen
 
     balances = state.balances.astype(np.int64)
     balances = np.maximum(0, balances + rewards - penalties)
@@ -513,10 +535,13 @@ def _per_epoch_phase0(state: BeaconState) -> None:
     state.current_epoch_attestations = []
 
 
-def _process_rewards_and_penalties_phase0(state: BeaconState,
-                                          total_active: int) -> None:
-    if state.current_epoch() == GENESIS_EPOCH:
-        return
+def phase0_reward_deltas(state: BeaconState, total_active: int
+                         ) -> dict[str, tuple[np.ndarray, np.ndarray]]:
+    """Per-component (rewards, penalties) int64 arrays matching the spec's
+    get_attestation_deltas split — the EF `rewards` runner's handlers:
+    source/target/head (get_{source,target,head}_deltas),
+    inclusion_delay (get_inclusion_delay_deltas, no penalties),
+    inactivity (get_inactivity_penalty_deltas, no rewards)."""
     p = state.T.preset
     n = len(state.validators)
     eligible = eligible_validator_mask(state)
@@ -532,9 +557,10 @@ def _process_rewards_and_penalties_phase0(state: BeaconState,
     head_mask = _attesting_mask_phase0(state, atts, require_target=True,
                                        require_head=True)
 
-    rewards = np.zeros(n, dtype=np.int64)
-    penalties = np.zeros(n, dtype=np.int64)
-    for mask in (source_mask, target_mask, head_mask):
+    out: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+    for name, mask in (("source", source_mask), ("target", target_mask),
+                       ("head", head_mask)):
+        rewards = np.zeros(n, dtype=np.int64)
         att_balance = int(state.validators.effective_balance[mask].sum())
         if leak:
             # full base reward during a leak (cancelled by the inactivity
@@ -544,7 +570,8 @@ def _process_rewards_and_penalties_phase0(state: BeaconState,
             rewards += np.where(
                 eligible & mask,
                 base * (att_balance // inc) // (total_active // inc), 0)
-        penalties += np.where(eligible & ~mask, base, 0)
+        penalties = np.where(eligible & ~mask, base, 0)
+        out[name] = (rewards, penalties)
 
     # inclusion delay rewards: min-delay attestation per attester
     proposer_reward = base // p.proposer_reward_quotient
@@ -557,20 +584,36 @@ def _process_rewards_and_penalties_phase0(state: BeaconState,
                                    best_delay[idx])
         best_proposer[idx] = np.where(better, a.proposer_index,
                                       best_proposer[idx])
+    incl_rewards = np.zeros(n, dtype=np.int64)
     for i in np.flatnonzero(source_mask):
-        rewards[best_proposer[i]] += int(proposer_reward[i])
+        incl_rewards[best_proposer[i]] += int(proposer_reward[i])
         max_attester = int(base[i]) - int(proposer_reward[i])
-        rewards[i] += max_attester * p.min_attestation_inclusion_delay \
+        incl_rewards[i] += max_attester * p.min_attestation_inclusion_delay \
             // int(best_delay[i])
+    out["inclusion_delay"] = (incl_rewards, np.zeros(n, dtype=np.int64))
 
+    inact_penalties = np.zeros(n, dtype=np.int64)
     if leak:
         finality_delay = _finality_delay(state)
-        penalties += np.where(eligible,
-                              BASE_REWARDS_PER_EPOCH * base - proposer_reward,
-                              0)
-        penalties += np.where(eligible & ~target_mask,
-                              eb * finality_delay
-                              // p.inactivity_penalty_quotient, 0)
+        inact_penalties += np.where(
+            eligible, BASE_REWARDS_PER_EPOCH * base - proposer_reward, 0)
+        inact_penalties += np.where(eligible & ~target_mask,
+                                    eb * finality_delay
+                                    // p.inactivity_penalty_quotient, 0)
+    out["inactivity"] = (np.zeros(n, dtype=np.int64), inact_penalties)
+    return out
+
+
+def _process_rewards_and_penalties_phase0(state: BeaconState,
+                                          total_active: int) -> None:
+    if state.current_epoch() == GENESIS_EPOCH:
+        return
+    components = phase0_reward_deltas(state, total_active)
+    rewards = np.zeros(len(state.validators), dtype=np.int64)
+    penalties = np.zeros(len(state.validators), dtype=np.int64)
+    for r, pen in components.values():
+        rewards += r
+        penalties += pen
 
     balances = state.balances.astype(np.int64)
     state.balances = np.maximum(0, balances + rewards - penalties).astype(
