@@ -17,7 +17,8 @@ import argparse
 import sys
 import time
 
-from .discv5 import Discv5, Enr
+from .discv5 import Discv5
+from .enr import Enr
 
 
 class Discovery:
@@ -60,9 +61,9 @@ class Discovery:
         """Dial an ENR's TCP endpoint unless we already hold a live
         connection from a previous dial of that address."""
         svc = self.service
-        if enr.tcp_port == 0:
+        if not enr.tcp():
             return False   # bootnode-style record: not dialable over TCP
-        addr = (enr.ip, enr.tcp_port)
+        addr = (enr.ip(), enr.tcp())
         if addr == (svc.transport.host, svc.port):
             return False
         live = self._dialed.get(addr)
@@ -136,7 +137,7 @@ def main(argv=None) -> int:
     node = BootNode(args.host, args.port)
     node.start()
     print(f"bootnode listening on {args.host}:{node.port} (udp)")
-    print(f"enr: {node.enr.encode().hex()}")
+    print(f"enr: {node.enr.to_text()}")
     try:
         while True:
             time.sleep(3600)

@@ -50,7 +50,8 @@ class NetworkService:
         self.sync = SyncManager(chain, self.rpc, self.peers)
 
         self.transport.on_peer = self._on_peer
-        self.transport.on_frame = self._on_frame
+        self.transport.on_gossip_rpc = \
+            lambda peer, rpc: self.gossip.handle_rpc(peer, rpc)
         self.transport.on_disconnect = self._on_disconnect
         self.gossip.validator = self._validate_gossip
         self.gossip.on_message = self._deliver_gossip
@@ -120,12 +121,6 @@ class NetworkService:
         self.peers.on_disconnect(peer.node_id)
         self.gossip.on_peer_disconnected(peer.node_id)
 
-    def _on_frame(self, peer, kind: int, payload: bytes) -> None:
-        if kind == GossipEngine.GOSSIP_FRAME:
-            self.gossip.handle_frame(peer, payload)
-        else:
-            self.rpc.handle_frame(peer, kind, payload)
-
     def _ban(self, node_id: str) -> None:
         peer = self.transport.peers.get(node_id)
         if peer is not None:
@@ -188,7 +183,7 @@ class NetworkService:
             seen = root
             blk = self.chain.store.get_block(root)
             if blk is not None and blk.message.slot >= start:
-                out.append(encode_block(blk))
+                out.append(encode_block(blk, self.chain))
         return out
 
     def _blocks_by_root(self, peer, payload) -> list[str]:
@@ -196,7 +191,7 @@ class NetworkService:
         for root_hex in payload.get("roots", [])[:64]:
             blk = self.chain.store.get_block(bytes.fromhex(root_hex))
             if blk is not None:
-                out.append(encode_block(blk))
+                out.append(encode_block(blk, self.chain))
         return out
 
     # -- gossip validation / delivery ----------------------------------------

@@ -26,6 +26,7 @@ class SyncManager:
         self.rpc = rpc
         self.peers = peer_manager
         self.state = "synced"          # synced | range_syncing
+        self._digest_map = None        # lazy fork-digest -> ForkName
         self._lock = threading.Lock()
 
     # -- range sync ----------------------------------------------------------
@@ -235,15 +236,30 @@ class SyncManager:
     def _decode_block(self, hex_payload: str):
         try:
             raw = bytes.fromhex(hex_payload)
-            from ..specs.chain_spec import ForkName
-            fork = ForkName(raw[0])
-            cls = self.chain.T.SignedBeaconBlock[fork]
-            return deserialize(cls.ssz_type, raw[1:])
+            dmap = self._digest_map
+            if dmap is None:
+                dmap = self._digest_map = digest_to_fork(self.chain)
+            cls = self.chain.T.SignedBeaconBlock[dmap[raw[:4]]]
+            return deserialize(cls.ssz_type, raw[4:])
         except Exception:
             return None
 
 
-def encode_block(signed_block) -> str:
-    fork = signed_block.fork_name
-    return (bytes([fork.value])
+def digest_to_fork(chain) -> dict:
+    """4-byte fork-digest -> ForkName, for the chunk context bytes the
+    real req/resp protocol leads block chunks with
+    (rpc/codec/ssz_snappy.rs context_bytes)."""
+    from ..specs.chain_spec import ForkName, compute_fork_digest
+    return {compute_fork_digest(chain.spec.fork_version(f),
+                                chain.genesis_validators_root): f
+            for f in ForkName}
+
+
+def encode_block(signed_block, chain) -> str:
+    """fork-digest context (4B) + SSZ, as one response chunk payload."""
+    from ..specs.chain_spec import compute_fork_digest
+    digest = compute_fork_digest(
+        chain.spec.fork_version(signed_block.fork_name),
+        chain.genesis_validators_root)
+    return (digest
             + serialize(type(signed_block).ssz_type, signed_block)).hex()

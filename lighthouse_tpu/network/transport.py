@@ -1,55 +1,185 @@
-"""Encrypted framed TCP transport.
+"""The libp2p transport stack — REAL wire protocols end to end.
 
-Connection setup runs the noise-like handshake (network/noise.py): peers
-are identified by sha256(static_pub)[:8] — an AUTHENTICATED id, not a
-self-claimed one.  After the handshake every frame is one AEAD envelope:
+Connection upgrade path, exactly as the reference builds it
+(beacon_node/lighthouse_network/src/service/utils.rs:80-130
+build_transport):
 
-    [u32 ciphertext_len][ciphertext]
-    plaintext = [u8 kind][payload]        kind: 1 gossip, 2 rpc-req,
-                                                3 rpc-resp
+    TCP
+    └─ multistream-select          "/noise"
+       └─ Noise XX                 (noise_xx.py — identity-certified)
+          └─ multistream-select    "/yamux/1.0.0"   (inside noise frames)
+             └─ yamux session      (yamux.py — SYN/ACK streams, windows)
+                ├─ /meshsub/1.2.0 streams: varint-delimited gossipsub
+                │    RPC protobufs (gossipsub_pb.py), one long-lived
+                │    outbound stream per peer
+                └─ /eth2/beacon_chain/req/* streams: one per request
+                     (rpc.py — SSZ-snappy with result/context bytes)
 
-Per-direction nonce counters + transcript-bound associated data give
-ordering/splicing protection; a tampered frame fails AEAD and drops the
-connection (ref role: lighthouse_network/src/service/utils.rs noise XX).
+Peers are identified by their libp2p peer id (identity multihash of the
+secp256k1 identity key, authenticated inside the noise handshake).
 """
 from __future__ import annotations
 
+import secrets
 import socket
-import struct
 import threading
 
-from .noise import (
-    HandshakeError, NodeIdentity, initiator_handshake, node_id_of,
+from . import multistream as ms
+from . import secp256k1
+from .gossipsub_pb import unframe
+from .noise_xx import (
+    NoiseError, NoiseSession, initiator_handshake, peer_id_from_pubkey,
     responder_handshake,
 )
+from .yamux import Session, Stream, StreamIO, YamuxError
 
-# Sealed-frame cap: must fit a max-size gossip payload AFTER snappy's
-# worst-case ~0.8% expansion on incompressible data, and a full
-# max_request_blocks by_range response packed into one frame.
-MAX_FRAME = 64 * 1024 * 1024 + 4096
+PROTO_NOISE = "/noise"
+PROTO_YAMUX = "/yamux/1.0.0"
+PROTO_MESHSUB = ["/meshsub/1.2.0", "/meshsub/1.1.0"]
+
+
+class NodeIdentity:
+    """secp256k1 libp2p identity keypair."""
+
+    def __init__(self, priv: int | None = None):
+        self.priv = priv or int.from_bytes(secrets.token_bytes(32), "big") \
+            % (secp256k1.N - 1) + 1
+        self.pub = secp256k1.compress(secp256k1.pubkey(self.priv))
+        self.peer_id = peer_id_from_pubkey(self.pub)
+        self.node_id = self.peer_id.hex()
+
+
+class _NoiseIO:
+    """Byte-stream view over a NoiseSession (for multistream + yamux)."""
+
+    def __init__(self, sock, session: NoiseSession):
+        self.sock = sock
+        self.session = session
+        self._buf = bytearray()
+        self._wlock = threading.Lock()
+
+    def read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            self._buf += self.session.recv(self.sock)
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def recv_any(self) -> bytes:
+        """One noise frame's plaintext (+ any buffered leftovers)."""
+        if self._buf:
+            out = bytes(self._buf)
+            self._buf.clear()
+            return out
+        return self.session.recv(self.sock)
+
+    def write(self, data: bytes) -> None:
+        with self._wlock:
+            self.session.send(self.sock, data)
 
 
 class Peer:
-    def __init__(self, sock: socket.socket, addr, node_id: str,
-                 channel, outbound: bool):
+    """One upgraded connection: noise-authenticated, yamux-multiplexed."""
+
+    def __init__(self, transport: "Transport", sock, addr,
+                 io: _NoiseIO, outbound: bool):
+        self.transport = transport
         self.sock = sock
         self.addr = addr
-        self.node_id = node_id
-        self.channel = channel
+        self.io = io
         self.outbound = outbound
-        self._send_lock = threading.Lock()
+        self.node_id = io.session.remote_peer_id.hex()
         self.alive = True
+        self.mux = Session(io.write, initiator=outbound,
+                           on_stream=self._on_inbound_stream)
+        self._gossip_out: Stream | None = None
+        self._gossip_lock = threading.Lock()
+        self._gossip_in_buf = bytearray()
 
-    def send_frame(self, kind: int, payload: bytes) -> None:
-        with self._send_lock:
+    # -- outbound streams ------------------------------------------------------
+
+    def open_protocol(self, protocols: list[str],
+                      timeout: float = 10.0) -> tuple[Stream, str]:
+        st = self.mux.open_stream()
+        proto = ms.negotiate_out(StreamIO(st, timeout), protocols)
+        return st, proto
+
+    def send_gossip_rpc(self, framed: bytes) -> None:
+        """Write one varint-framed gossipsub RPC on the persistent
+        meshsub stream (opened lazily)."""
+        with self._gossip_lock:
+            if self._gossip_out is None or self._gossip_out.reset:
+                try:
+                    self._gossip_out, _ = self.open_protocol(PROTO_MESHSUB)
+                except (ms.MultistreamError, YamuxError, OSError):
+                    self._gossip_out = None
+                    return
             try:
-                sealed = self.channel.seal(bytes([kind]) + payload)
-                self.sock.sendall(struct.pack("<I", len(sealed)) + sealed)
-            except OSError:
-                self.alive = False
+                self._gossip_out.write(framed)
+            except (YamuxError, OSError):
+                self._gossip_out = None
+
+    # -- inbound streams -------------------------------------------------------
+
+    def _on_inbound_stream(self, stream: Stream) -> None:
+        threading.Thread(target=self._serve_stream, args=(stream,),
+                         daemon=True).start()
+
+    def _serve_stream(self, stream: Stream) -> None:
+        try:
+            supported = PROTO_MESHSUB + self.transport.rpc_protocols
+            proto = ms.negotiate_in(StreamIO(stream), supported)
+        except (ms.MultistreamError, YamuxError):
+            stream.rst()
+            return
+        if proto in PROTO_MESHSUB:
+            self._gossip_read_loop(stream)
+        else:
+            try:
+                self.transport.on_rpc_stream(self, proto, stream)
+            except Exception:
+                import logging
+                logging.getLogger("lighthouse_tpu.network").exception(
+                    "rpc stream handler failed (peer %s)", self.node_id)
+                stream.rst()
+
+    def _gossip_read_loop(self, stream: Stream) -> None:
+        from .gossipsub_pb import MAX_RPC_SIZE, PbError
+        buf = bytearray()
+        while self.alive and not stream.reset:
+            try:
+                chunk = stream.read(timeout=30.0)
+            except YamuxError:
+                return
+            if not chunk:
+                if stream.recv_closed:
+                    return
+                continue
+            buf += chunk
+            if len(buf) > MAX_RPC_SIZE + 10:
+                stream.rst()       # oversized frame: peer misbehavior
+                return
+            while True:
+                try:
+                    rpc = unframe(buf)
+                except PbError:
+                    stream.rst()   # malformed frame: stop reading them
+                    return
+                if rpc is None:
+                    break
+                try:
+                    self.transport.on_gossip_rpc(self, rpc)
+                except Exception:
+                    import logging
+                    logging.getLogger("lighthouse_tpu.network").exception(
+                        "gossip handler failed (peer %s)", self.node_id)
 
     def close(self) -> None:
         self.alive = False
+        try:
+            self.mux.goaway()
+        except Exception:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -57,8 +187,9 @@ class Peer:
 
 
 class Transport:
-    """Listener + dialer; hands connected Peers to `on_peer`, frames to
-    `on_frame(peer, kind, payload)`."""
+    """Listener + dialer; hands upgraded Peers to `on_peer`, gossipsub
+    RPCs to `on_gossip_rpc(peer, rpc)`, req/resp streams to
+    `on_rpc_stream(peer, protocol, stream)`."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  identity: NodeIdentity | None = None):
@@ -71,8 +202,11 @@ class Transport:
         self.port = self.listener.getsockname()[1]
         self.host = host
         self.on_peer = lambda peer: None
-        self.on_frame = lambda peer, kind, payload: None
+        self.on_gossip_rpc = lambda peer, rpc: None
+        self.on_rpc_stream = lambda peer, protocol, stream: None
         self.on_disconnect = lambda peer: None
+        #: protocol ids served on inbound streams (set by RpcHandler)
+        self.rpc_protocols: list[str] = []
         self.peers: dict[str, Peer] = {}
         self._stop = False
 
@@ -94,33 +228,36 @@ class Transport:
                 sock, addr = self.listener.accept()
             except OSError:
                 return
-            threading.Thread(target=self._handshake_in,
+            threading.Thread(target=self._upgrade_in,
                              args=(sock, addr), daemon=True).start()
 
-    def _handshake_in(self, sock, addr) -> None:
+    # -- the upgrade path ------------------------------------------------------
+
+    def _upgrade_in(self, sock, addr) -> None:
         try:
             sock.settimeout(10)
-            channel, remote_static = responder_handshake(
-                sock.sendall, lambda n: _read_exact(sock, n), self.identity)
+            ms.negotiate_in(sock, [PROTO_NOISE])
+            session = responder_handshake(sock, self.identity.priv)
+            io = _NoiseIO(sock, session)
+            ms.negotiate_in(io, [PROTO_YAMUX])
             sock.settimeout(None)
-            peer = Peer(sock, addr, node_id_of(remote_static), channel,
-                        outbound=False)
-            self._register(peer)
-        except (OSError, ValueError, HandshakeError):
+            self._register(Peer(self, sock, addr, io, outbound=False))
+        except (OSError, ValueError, NoiseError, ms.MultistreamError):
             sock.close()
 
     def dial(self, host: str, port: int) -> Peer | None:
         try:
             sock = socket.create_connection((host, port), timeout=5)
             sock.settimeout(10)
-            channel, remote_static = initiator_handshake(
-                sock.sendall, lambda n: _read_exact(sock, n), self.identity)
+            ms.negotiate_out(sock, [PROTO_NOISE])
+            session = initiator_handshake(sock, self.identity.priv)
+            io = _NoiseIO(sock, session)
+            ms.negotiate_out(io, [PROTO_YAMUX])
             sock.settimeout(None)
-            peer = Peer(sock, (host, port), node_id_of(remote_static),
-                        channel, outbound=True)
+            peer = Peer(self, sock, (host, port), io, outbound=True)
             self._register(peer)
             return peer
-        except (OSError, ValueError, HandshakeError):
+        except (OSError, ValueError, NoiseError, ms.MultistreamError):
             return None
 
     def _register(self, peer: Peer) -> None:
@@ -130,36 +267,16 @@ class Transport:
         self.on_peer(peer)
 
     def _read_loop(self, peer: Peer) -> None:
-        import logging
+        """Pump noise plaintext into the yamux session."""
         try:
             while peer.alive and not self._stop:
-                hdr = _read_exact(peer.sock, 4)
-                (length,) = struct.unpack("<I", hdr)
-                if length > MAX_FRAME:
-                    raise ValueError("frame too large")
-                sealed = _read_exact(peer.sock, length)
-                plain = peer.channel.open(sealed)  # tampering -> drop conn
-                kind, payload = plain[0], plain[1:]
-                try:
-                    self.on_frame(peer, kind, payload)
-                except Exception:
-                    # a handler bug must not kill the reader / skip cleanup
-                    logging.getLogger("lighthouse_tpu.network").exception(
-                        "frame handler failed (peer %s)", peer.node_id)
-        except (OSError, ValueError, HandshakeError, IndexError):
+                peer.mux.on_bytes(peer.io.recv_any())
+                if peer.mux.closed:
+                    break
+        except (OSError, NoiseError, YamuxError):
             pass
         peer.alive = False
         # a redialed peer may have replaced this entry — only pop ourselves
         if self.peers.get(peer.node_id) is peer:
             self.peers.pop(peer.node_id, None)
             self.on_disconnect(peer)
-
-
-def _read_exact(sock, n: int) -> bytes:
-    out = b""
-    while len(out) < n:
-        chunk = sock.recv(n - len(out))
-        if not chunk:
-            raise OSError("connection closed")
-        out += chunk
-    return out

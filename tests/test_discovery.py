@@ -9,19 +9,20 @@ from lighthouse_tpu.crypto import bls
 from lighthouse_tpu.network import NetworkService
 from lighthouse_tpu.network.discovery import BootNode, Discovery
 from lighthouse_tpu.network.discv5 import (
-    Discv5, Discv5Error, Enr, KBuckets, LocalEnr, log2_distance,
+    Discv5, KBuckets, LocalEnr, attnets_int, log2_distance,
 )
+from lighthouse_tpu.network.enr import Enr, EnrError
 from lighthouse_tpu.specs import minimal_spec
 
 
 def test_enr_roundtrip_and_tamper():
     local = LocalEnr("127.0.0.1", 9999, tcp_port=9000)
     local.set_attnets(0b1010)
-    blob = local.record.encode()
-    dec = Enr.decode(blob)
+    blob = local.record.to_rlp()
+    dec = Enr.from_rlp(blob)
     assert dec.node_id == local.node_id
-    assert dec.ip == "127.0.0.1" and dec.udp_port == 9999
-    assert dec.tcp_port == 9000 and dec.attnets() == 0b1010
+    assert dec.ip() == "127.0.0.1" and dec.udp() == 9999
+    assert dec.tcp() == 9000 and attnets_int(dec) == 0b1010
     # seq bumps on every update and old records lose to new ones
     seq0 = dec.seq
     local.set_syncnets(0b1)
@@ -29,8 +30,8 @@ def test_enr_roundtrip_and_tamper():
     # any bit flip breaks the secp256k1 signature
     bad = bytearray(blob)
     bad[-1] ^= 1
-    with pytest.raises(Discv5Error):
-        Enr.decode(bytes(bad))
+    with pytest.raises(EnrError):
+        Enr.from_rlp(bytes(bad))
 
 
 def test_kbuckets_distance_and_eviction():
@@ -122,7 +123,7 @@ def test_network_service_discovers_and_dials():
         assert mesh_ok >= 2, [len(s.transport.peers) for s in services]
         # ENR carries the dialable TCP port
         for svc, disco in zip(services, discos):
-            assert disco.enr.tcp_port == svc.port
+            assert disco.enr.tcp() == svc.port
     finally:
         for disco in discos:
             disco.stop()
