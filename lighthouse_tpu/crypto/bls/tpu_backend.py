@@ -92,10 +92,10 @@ class TpuBackend(PythonBackend):
         bits = k.scalars_to_bits(rands, RAND_BITS)
 
         # RLC scaling
-        spx, spy, spz = k.g1_scalar_mul(pk_x, pk_y, one1, bits)
-        ssx, ssy, ssz = k.g2_scalar_mul(sig_x, sig_y, one2, bits)
-        # aggregate scaled signatures (tree reduction)
-        ax, ay, az = _g2_tree_sum(k, ssx, ssy, ssz)
+        spx, spy, spz = k.g1_scalar_mul_jit(pk_x, pk_y, one1, bits)
+        ssx, ssy, ssz = k.g2_scalar_mul_jit(sig_x, sig_y, one2, bits)
+        # aggregate scaled signatures (scan reduction, 2 cached programs)
+        ax, ay, az = k.g2_sum(ssx, ssy, ssz)
 
         # affine for the miller loop
         apx, apy = k.jacobian_to_affine_fp(spx, spy, spz)
@@ -127,23 +127,3 @@ def _encode_g2_batch(k, points):
         xs.append(x)
         ys.append(y)
     return k.fp2_encode(xs), k.fp2_encode(ys)
-
-
-def _g2_tree_sum(k, x, y, z):
-    import jax.numpy as jnp
-    n = x.shape[0]
-    while n > 1:
-        if n % 2:
-            zero_pt = (jnp.asarray(np.broadcast_to(k.FP2_ONE,
-                                                   (1,) + x.shape[1:])),
-                       jnp.asarray(np.broadcast_to(k.FP2_ONE,
-                                                   (1,) + y.shape[1:])),
-                       jnp.zeros((1,) + z.shape[1:], dtype=jnp.int32))
-            x = jnp.concatenate([x, zero_pt[0]], axis=0)
-            y = jnp.concatenate([y, zero_pt[1]], axis=0)
-            z = jnp.concatenate([z, zero_pt[2]], axis=0)
-            n += 1
-        h = n // 2
-        x, y, z = k.g2_add(x[:h], y[:h], z[:h], x[h:], y[h:], z[h:])
-        n = h
-    return x[0], y[0], z[0]

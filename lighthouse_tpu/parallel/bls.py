@@ -27,22 +27,28 @@ from ..ops.bls12_381 import (
 )
 
 
-def _local_check(px, py, qx, qy, axis: str):
+def _local_miller_product(px, py, qx, qy):
     fs = miller_loop_batch(px, py, qx, qy)     # [local, 2, 3, 2, 32]
-    local = fp12_product(fs)                   # [2, 3, 2, 32]
-    partials = jax.lax.all_gather(local, axis)  # [n_dev, ...] over ICI
-    out = final_exponentiation(fp12_product(partials))
-    return fp12_eq(out[None], fp12_one_like((1,)))  # [1] bool, replicated
+    return fp12_product(fs)[None]              # [1, 2, 3, 2, 32]
 
 
 def sharded_pairing_check(mesh: Mesh, px, py, qx, qy,
                           axis: str = "batch"):
     """prod_i e(P_i, Q_i) == 1 with the pair batch row-sharded over the
-    mesh.  The batch size must divide evenly across mesh[axis]."""
+    mesh.  The batch size must divide evenly across mesh[axis].
+
+    STAGED (compile-regime discipline, ops/bls12_381.py): stage 1 is the
+    sharded Miller loop + per-chip local product — its out_spec gathers
+    the n_dev partials over ICI (n_dev * 1.5 KiB, one tiny collective);
+    stage 2 (tiny product + the shared final exponentiation + identity
+    check) runs as separate cached programs on the gathered result.  One
+    fused program here was the round-2 ~12-minute compile."""
     fn = shard_map(
-        functools.partial(_local_check, axis=axis),
+        _local_miller_product,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(axis),
     )
-    return jax.jit(fn)(px, py, qx, qy)[0]
+    partials = jax.jit(fn)(px, py, qx, qy)     # [n_dev, 2, 3, 2, 32]
+    out = final_exponentiation(fp12_product(partials))
+    return fp12_eq(out[None], fp12_one_like((1,)))[0]

@@ -107,7 +107,6 @@ def test_miller_loop_matches_python():
     assert k.fp_decode(prod) == _f12_to_ints(want)
 
 
-@pytest.mark.skipif("not __import__('os').environ.get('LHTPU_SLOW_TESTS')")
 def test_final_exp_matches_python():
     pairs = [(G1_GENERATOR.mul(3), G2_GENERATOR.mul(5))]
     px, py = _encode_g1([p for p, _ in pairs])
@@ -118,7 +117,6 @@ def test_final_exp_matches_python():
     assert k.fp_decode(out) == _f12_to_ints(want)
 
 
-@pytest.mark.skipif("not __import__('os').environ.get('LHTPU_SLOW_TESTS')")
 def test_pairing_check_verifies_signature():
     sk = keygen_interop(3)
     pk = sk_to_pk(sk)

@@ -39,7 +39,6 @@ def test_sharded_state_root_step():
         np.asarray(k.merkleize_words(np.asarray(b), 64)))
 
 
-@pytest.mark.skipif("not __import__('os').environ.get('LHTPU_SLOW_TESTS')")
 def test_sharded_pairing_check_matches_single_device():
     import numpy as np
     import lighthouse_tpu.ops.bls12_381 as k
