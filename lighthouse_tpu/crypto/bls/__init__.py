@@ -186,6 +186,10 @@ class PythonBackend(BlsBackend):
         return g1_compress(out)
 
     def validate_pubkey(self, pk: bytes) -> bool:
+        # spec KeyValidate: reject the identity point as well as
+        # malformed/off-curve encodings
+        if pk == b"\xc0" + b"\x00" * 47:
+            return False
         try:
             self._pk(pk)
             return True

@@ -627,9 +627,17 @@ def gen_transition(root: Path) -> int:
     return n
 
 
-def main(dest: str | None = None) -> None:
+def main(dest: str | None = None, only: list[str] | None = None) -> None:
+    """`only`: resume/partial mode — run just the named round-3
+    generators without wiping the tree (generators overwrite their own
+    case dirs)."""
     dest_root = Path(dest or Path(__file__).resolve().parents[2]
                      / "tests" / "ef_vectors" / "tests")
+    from .gen_corpus_r3 import generate_all
+    if only:
+        n = generate_all(dest_root, only)
+        print(f"wrote {n} cases (partial: {only}) under {dest_root}")
+        return
     if dest_root.exists():
         shutil.rmtree(dest_root)
     n = 0
@@ -639,8 +647,14 @@ def main(dest: str | None = None) -> None:
     n += gen_shuffling(dest_root)
     n += gen_kzg(dest_root)
     n += gen_transition(dest_root)
+    n += generate_all(dest_root)
     print(f"wrote {n} cases under {dest_root}")
 
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else None)
+    args = sys.argv[1:]
+    only = None
+    if args and args[0] == "--only":
+        only = args[1].split(",")
+        args = args[2:]
+    main(args[0] if args else None, only=only)
