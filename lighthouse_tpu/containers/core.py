@@ -431,6 +431,40 @@ def _build_types(p: Preset) -> Types:
         BeaconBlock[fork] = blk
         SignedBeaconBlock[fork] = sblk
 
+    # -- blinded blocks (builder/MEV flow) -----------------------------------
+    # Same bodies with execution_payload swapped IN PLACE for its header
+    # (field order preserved => identical merkleization up to that leaf),
+    # matching the reference's BlindedPayload variants
+    # (consensus/types/src/payload.rs; execution_layer/src/lib.rs:807).
+    BlindedBeaconBlockBody = {}
+    BlindedBeaconBlock = {}
+    SignedBlindedBeaconBlock = {}
+    for fork, body in BeaconBlockBody.items():
+        if fork < ForkName.BELLATRIX:
+            continue
+        ns = {}
+        for fname, ftyp in body.__ssz_fields__.items():
+            if fname == "execution_payload":
+                ns["execution_payload_header"] = \
+                    ExecutionPayloadHeader[fork].ssz_type
+            else:
+                ns[fname] = ftyp
+        bbody = container(type(
+            f"BlindedBeaconBlockBody{fork.name.title()}", (),
+            {"__annotations__": ns}))
+        bblk = container(type(f"BlindedBeaconBlock{fork.name.title()}", (), {
+            "__annotations__": dict(
+                slot=uint64, proposer_index=uint64, parent_root=Root,
+                state_root=Root, body=bbody.ssz_type)}))
+        sbblk = container(type(
+            f"SignedBlindedBeaconBlock{fork.name.title()}", (), {
+                "__annotations__": dict(message=bblk.ssz_type,
+                                        signature=Bytes96)}))
+        bbody.fork_name = bblk.fork_name = sbblk.fork_name = fork
+        BlindedBeaconBlockBody[fork] = bbody
+        BlindedBeaconBlock[fork] = bblk
+        SignedBlindedBeaconBlock[fork] = sbblk
+
     # -- aggregation wrappers ------------------------------------------------
     @container
     class AggregateAndProof:
@@ -498,7 +532,9 @@ def _build_types(p: Preset) -> Types:
         block_root: Root
         index: uint64
 
-    # -- light client (subset; full protocol in api/light_client) ------------
+    # -- light client (altair wire forms; branches at the altair..deneb
+    # generalized-index depths — current_sync_committee gindex 54 (depth
+    # 5), finalized_root gindex 105 (depth 6); types/src/light_client_*.rs)
     @container
     class LightClientHeader:
         beacon: BeaconBlockHeader.ssz_type
@@ -508,12 +544,43 @@ def _build_types(p: Preset) -> Types:
         next_sync_committee: SyncCommittee.ssz_type
         next_sync_committee_branch: Vector(Bytes32, 5)
 
+    @container
+    class LightClientBootstrap:
+        header: LightClientHeader.ssz_type
+        current_sync_committee: SyncCommittee.ssz_type
+        current_sync_committee_branch: Vector(Bytes32, 5)
+
+    @container
+    class LightClientUpdate:
+        attested_header: LightClientHeader.ssz_type
+        next_sync_committee: SyncCommittee.ssz_type
+        next_sync_committee_branch: Vector(Bytes32, 5)
+        finalized_header: LightClientHeader.ssz_type
+        finality_branch: Vector(Bytes32, 6)
+        sync_aggregate: SyncAggregate.ssz_type
+        signature_slot: uint64
+
+    @container
+    class LightClientFinalityUpdate:
+        attested_header: LightClientHeader.ssz_type
+        finalized_header: LightClientHeader.ssz_type
+        finality_branch: Vector(Bytes32, 6)
+        sync_aggregate: SyncAggregate.ssz_type
+        signature_slot: uint64
+
+    @container
+    class LightClientOptimisticUpdate:
+        attested_header: LightClientHeader.ssz_type
+        sync_aggregate: SyncAggregate.ssz_type
+        signature_slot: uint64
+
     # -- export everything ---------------------------------------------------
     ns = dict(locals())
     for k, v in ns.items():
         if k not in ("T", "p", "ns", "payload_cls", "body_cls",
                      "payload_base", "body_phase0", "electra_ns",
-                     "header_extra", "fork", "body", "blk", "sblk", "k", "v"):
+                     "header_extra", "fork", "body", "blk", "sblk", "k", "v",
+                     "fname", "ftyp", "bbody", "bblk", "sbblk"):
             setattr(T, k, v)
     T.max_validators_per_slot = max_validators_per_slot
     T.eth1_votes_limit = eth1_votes_limit

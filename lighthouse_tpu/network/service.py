@@ -90,6 +90,15 @@ class NetworkService:
         self.rpc.register("goodbye", self._handle_goodbye)
         self.rpc.register("beacon_blocks_by_range", self._blocks_by_range)
         self.rpc.register("beacon_blocks_by_root", self._blocks_by_root)
+        # light-client protocols served straight from the server cache
+        # (ref: lighthouse_network/src/rpc/protocol.rs:236-266 entries)
+        self.rpc.register("light_client_bootstrap", self._lc_bootstrap)
+        self.rpc.register("light_client_finality_update",
+                          self._lc_finality_update)
+        self.rpc.register("light_client_optimistic_update",
+                          self._lc_optimistic_update)
+        self.rpc.register("light_client_updates_by_range",
+                          self._lc_updates_by_range)
 
     @property
     def port(self) -> int:
@@ -192,6 +201,49 @@ class NetworkService:
             blk = self.chain.store.get_block(bytes.fromhex(root_hex))
             if blk is not None:
                 out.append(encode_block(blk, self.chain))
+        return out
+
+    # -- light-client req/resp serving ---------------------------------------
+
+    def _lc_chunk(self, obj) -> str:
+        data = serialize(type(obj).ssz_type, obj)
+        return (self.gossip.fork_digest + data).hex()
+
+    def _lc_bootstrap(self, peer, payload) -> list[str]:
+        from ..chain.light_client import bootstrap_ssz
+        b = self.chain.light_client_cache.produce_bootstrap(
+            bytes.fromhex(payload["root"]))
+        try:
+            return [self._lc_chunk(bootstrap_ssz(self.chain.T, b))] \
+                if b is not None else []
+        except ValueError:
+            return []      # electra-depth branches don't fit the wire form
+
+    def _lc_finality_update(self, peer, payload) -> list[str]:
+        from ..chain.light_client import finality_update_ssz
+        u = self.chain.light_client_cache.latest_finality_update
+        try:
+            return [self._lc_chunk(finality_update_ssz(self.chain.T, u))] \
+                if u is not None else []
+        except ValueError:
+            return []
+
+    def _lc_optimistic_update(self, peer, payload) -> list[str]:
+        from ..chain.light_client import optimistic_update_ssz
+        u = self.chain.light_client_cache.latest_optimistic_update
+        return [self._lc_chunk(optimistic_update_ssz(self.chain.T, u))] \
+            if u is not None else []
+
+    def _lc_updates_by_range(self, peer, payload) -> list[str]:
+        from ..chain.light_client import update_ssz
+        updates = self.chain.light_client_cache.updates_by_range(
+            int(payload["start_period"]), int(payload["count"]))
+        out = []
+        for u in updates:
+            try:
+                out.append(self._lc_chunk(update_ssz(self.chain.T, u)))
+            except ValueError:
+                continue
         return out
 
     # -- gossip validation / delivery ----------------------------------------
