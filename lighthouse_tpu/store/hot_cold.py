@@ -24,13 +24,15 @@ from .kv import KeyValueStore, StoreError
 BLOCK = b"b:"
 HOT_STATE_FULL = b"S:"
 HOT_STATE_SUMMARY = b"s:"
-FREEZER_BLOCK_ROOT = b"fbr:"   # slot (be64) -> block root
+FREEZER_BLOCK_ROOT = b"fbr:"   # v1 layout: slot (be64) -> block root
+FREEZER_BLOCK_CHUNK = b"cbr:"  # v2 layout: chunked root vector
+FREEZER_STATE_CHUNK = b"csr:"  # v2: chunked state-root vector
 FREEZER_STATE = b"fst:"        # slot (be64) -> full state
 BLOBS = b"o:"
 METADATA = b"m:"
 ITEM = b"i:"                   # generic persisted items (fork choice, op pool)
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2             # v2: chunked freezer root vectors
 
 
 @dataclass
@@ -43,17 +45,50 @@ class Split:
 class StoreConfig:
     slots_per_restore_point: int = 2048
     compact_on_prune: bool = True
+    state_cache_size: int = 8      # replayed/cold states kept hot in RAM
+
+
+class _StateCache:
+    """Bounded LRU of fully-materialized states (store/src/state_cache.rs
+    role): cold-state loads replay O(slots_per_restore_point) blocks, so
+    repeated historical reads must not re-pay that."""
+
+    def __init__(self, capacity: int):
+        from collections import OrderedDict
+        self.capacity = capacity
+        self._od = OrderedDict()
+
+    def get(self, key):
+        st = self._od.get(key)
+        if st is not None:
+            self._od.move_to_end(key)
+        return st
+
+    def put(self, key, state) -> None:
+        self._od[key] = state
+        self._od.move_to_end(key)
+        while len(self._od) > self.capacity:
+            self._od.popitem(last=False)
+
+    def clear(self) -> None:
+        self._od.clear()
 
 
 class HotColdDB:
     def __init__(self, hot: KeyValueStore, cold: KeyValueStore,
                  spec: ChainSpec, config: StoreConfig | None = None):
+        from .chunked_vector import ChunkedRootVector
         self.hot = hot
         self.cold = cold
         self.spec = spec
         self.T = get_types(spec.preset)
         self.config = config or StoreConfig()
         self.split = self._load_split()
+        self.block_roots = ChunkedRootVector(cold, FREEZER_BLOCK_CHUNK)
+        self.state_roots = ChunkedRootVector(cold, FREEZER_STATE_CHUNK)
+        self.state_cache = _StateCache(self.config.state_cache_size)
+        from .schema_change import migrate_schema
+        migrate_schema(self)
         self._put_meta(b"schema", struct.pack("<I", SCHEMA_VERSION))
 
     # -- metadata ------------------------------------------------------------
@@ -232,18 +267,27 @@ class HotColdDB:
     # -- freezer -------------------------------------------------------------
 
     def freezer_put_block_root(self, slot: int, block_root: bytes) -> None:
-        self.cold.put(FREEZER_BLOCK_ROOT + struct.pack(">Q", slot),
-                      block_root)
+        self.block_roots.put(slot, block_root)
 
     def freezer_block_root_at_slot(self, slot: int) -> bytes | None:
-        return self.cold.get(FREEZER_BLOCK_ROOT + struct.pack(">Q", slot))
+        return self.block_roots.get(slot)
+
+    def freezer_put_state_root(self, slot: int, state_root: bytes) -> None:
+        self.state_roots.put(slot, state_root)
+
+    def freezer_state_root_at_slot(self, slot: int) -> bytes | None:
+        return self.state_roots.get(slot)
 
     def freezer_put_state(self, slot: int, state: BeaconState) -> None:
         data = bytes([state.fork_name.value]) + state.serialize()
         self.cold.put(FREEZER_STATE + struct.pack(">Q", slot), data)
 
     def load_cold_state_by_slot(self, slot: int) -> BeaconState | None:
-        """Load the nearest restore point at/below `slot` and replay."""
+        """Nearest restore point at/below `slot` + block replay, behind
+        the bounded state cache (state_cache.rs role)."""
+        cached = self.state_cache.get(("cold", slot))
+        if cached is not None:
+            return cached.copy()
         srp = self.config.slots_per_restore_point
         rp_slot = (slot // srp) * srp
         raw = None
@@ -258,20 +302,35 @@ class HotColdDB:
             return None
         state = BeaconState.from_ssz_bytes(raw[1:], self.T, self.spec,
                                            ForkName(raw[0]))
-        if state.slot == slot:
-            return state
-        blocks = []
-        seen = None
-        for s in range(state.slot + 1, slot + 1):
-            root = self.freezer_block_root_at_slot(s)
-            if root is None or root == seen:
-                continue  # skipped slot (same root repeated)
-            seen = root
+        if state.slot != slot:
+            blocks = []
+            seen = None
+            for s, root in self.block_roots.range(state.slot + 1,
+                                                  slot + 1):
+                if root is None or root == seen:
+                    continue  # skipped slot (same root repeated)
+                seen = root
+                blk = self.get_block(root)
+                if blk is not None and blk.message.slot > state.slot:
+                    blocks.append(blk)
+            from ..state_transition import BlockReplayer
+            state = BlockReplayer(state).apply_blocks(blocks,
+                                                      target_slot=slot)
+        self.state_cache.put(("cold", slot), state)
+        return state.copy()
+
+    def prune_blobs(self, before_slot: int) -> int:
+        """Drop blob sidecars for blocks older than `before_slot` (the
+        data-availability window boundary; store/src/hot_cold_store.rs
+        try_prune_blobs)."""
+        removed = 0
+        for key, _ in list(self.hot.iter_prefix(BLOBS)):
+            root = key[len(BLOBS):]
             blk = self.get_block(root)
-            if blk is not None and blk.message.slot > state.slot:
-                blocks.append(blk)
-        from ..state_transition import BlockReplayer
-        return BlockReplayer(state).apply_blocks(blocks, target_slot=slot)
+            if blk is None or blk.message.slot < before_slot:
+                self.hot.delete(key)
+                removed += 1
+        return removed
 
     # -- migration (freezing) ------------------------------------------------
 
@@ -291,16 +350,18 @@ class HotColdDB:
             root = canonical_roots.get(slot)
             if root is not None:
                 self.freezer_put_block_root(slot, root)
-        # restore points
+        # restore points + freezer state-root vector
         for slot in range(self.split.slot, finalized_slot + 1):
+            root = canonical_roots.get(slot)
+            if root is None:
+                continue
+            blk = self.get_block(root)
+            if blk is not None:
+                self.freezer_put_state_root(slot, blk.message.state_root)
             if slot % srp == 0:
-                root = canonical_roots.get(slot)
-                # summaries map state roots; load via hot state if available
                 st = None
-                if root is not None:
-                    blk = self.get_block(root)
-                    if blk is not None:
-                        st = self.get_hot_state(blk.message.state_root)
+                if blk is not None:
+                    st = self.get_hot_state(blk.message.state_root)
                 if st is not None:
                     self.freezer_put_state(slot, st)
         # prune abandoned forks
@@ -322,14 +383,68 @@ class HotColdDB:
     # -- iteration -----------------------------------------------------------
 
     def iter_block_roots_back(self, head_root: bytes):
-        """Walk (root, slot) back through parent links (forwards_iter.rs /
-        iter.rs equivalent, hot side)."""
+        """Walk (root, slot) back through parent links, crossing into the
+        freezer's chunked vector below the split (iter.rs equivalent)."""
         root = head_root
         while True:
             blk = self.get_block(root)
             if blk is None:
+                # below the split: continue from the chunked freezer roots
+                yield from self._iter_freezer_back(self.split.slot)
                 return
             yield root, blk.message.slot
             if blk.message.slot == 0:
                 return
+            if blk.message.slot <= self.split.slot:
+                yield from self._iter_freezer_back(blk.message.slot - 1)
+                return
             root = blk.message.parent_root
+
+    def _iter_freezer_back(self, from_slot: int):
+        seen = None
+        for slot in range(from_slot, -1, -1):
+            root = self.block_roots.get(slot)
+            if root is None or root == seen:
+                continue
+            seen = root
+            yield root, slot
+
+    def forwards_block_roots_iterator(self, start_slot: int,
+                                      end_slot: int,
+                                      head_root: bytes | None = None):
+        """(slot, root) ascending: freezer chunks below the split, then
+        the hot chain walked from `head_root`
+        (store/src/forwards_iter.rs)."""
+        boundary = min(end_slot, self.split.slot)
+        last = None
+        for slot, root in self.block_roots.range(start_slot, boundary + 1):
+            if root is not None:
+                last = root
+            if last is not None:
+                yield slot, last
+        if end_slot <= self.split.slot or head_root is None:
+            return
+        # hot side: walk parents back to the split, then emit ascending
+        # with skipped slots carrying the prior root (spec block_roots
+        # fill-forward semantics)
+        chain = []                       # (slot, root), descending
+        root = head_root
+        while True:
+            blk = self.get_block(root)
+            if blk is None:
+                break
+            chain.append((blk.message.slot, root))
+            if blk.message.slot <= self.split.slot + 1 or \
+                    blk.message.slot == 0:
+                break
+            root = blk.message.parent_root
+        chain.reverse()
+        idx = 0
+        current = None
+        for want in range(max(start_slot, self.split.slot + 1),
+                          end_slot + 1):
+            while idx < len(chain) and chain[idx][0] <= want:
+                current = chain[idx][1]
+                idx += 1
+            if current is not None:
+                yield want, current
