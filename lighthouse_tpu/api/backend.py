@@ -28,6 +28,10 @@ class ApiError(Exception):
 class ApiBackend:
     def __init__(self, chain: BeaconChain):
         self.chain = chain
+        #: payloads withheld from blinded production until the signed
+        #: blinded block returns (execution_layer/src/lib.rs get_payload
+        #: + unblinding flow); block_hash -> ExecutionPayload
+        self._blinded_payloads: dict[bytes, object] = {}
 
     # -- node ----------------------------------------------------------------
 
@@ -732,6 +736,55 @@ class ApiBackend:
             randao_reveal, slot, graffiti=graffiti or b"\x00" * 32)
         return serialize(type(block).ssz_type, block)
 
+    def produce_blinded_block_ssz(self, slot: int, randao_reveal: bytes,
+                                  graffiti: bytes | None = None) -> bytes:
+        """BlindedBeaconBlock SSZ; the payload is withheld until the
+        signed blinded block comes back through publish_blinded_block."""
+        from ..containers.blinded import blind_block
+        from ..specs.chain_spec import ForkName
+        from ..ssz import serialize
+        block, _post = self.chain.produce_block(
+            randao_reveal, slot, graffiti=graffiti or b"\x00" * 32)
+        if type(block).fork_name < ForkName.BELLATRIX:
+            return serialize(type(block).ssz_type, block)   # no payloads yet
+        blinded = blind_block(self.chain.T, block)
+        payload = block.body.execution_payload
+        self._blinded_payloads[payload.block_hash] = payload
+        if len(self._blinded_payloads) > 64:
+            self._blinded_payloads.pop(next(iter(self._blinded_payloads)))
+        return serialize(type(blinded).ssz_type, blinded)
+
+    def publish_blinded_block(self, body: bytes) -> None:
+        """Accepts SignedBlindedBeaconBlock SSZ: unblind (payload cache,
+        else the builder's blinded_blocks endpoint) and import."""
+        from ..containers.blinded import unblind_signed_block
+        from ..specs.chain_spec import ForkName
+        from ..ssz import deserialize
+        chain = self.chain
+        fork = chain.spec.fork_name_at_slot(chain.slot())
+        if fork < ForkName.BELLATRIX:
+            # no blinded form pre-bellatrix: let the caller's full-block
+            # fallback handle the body
+            raise ValueError("blinded blocks need an execution fork")
+        signed_blinded = deserialize(
+            chain.T.SignedBlindedBeaconBlock[fork].ssz_type, body)
+        header = signed_blinded.message.body.execution_payload_header
+        # .get, not .pop: if the import below fails, the withheld payload
+        # must survive for the VC's retry of the same signed block
+        payload = self._blinded_payloads.get(header.block_hash)
+        if payload is None and chain.builder is not None:
+            pj = chain.builder.submit_blinded_block(header.block_hash)
+            if pj is not None:
+                from ..execution_layer.execution_layer import (
+                    payload_from_json,
+                )
+                payload = payload_from_json(chain.T, fork, pj)
+        if payload is None:
+            raise ApiError(400, "unknown payload for blinded block")
+        full = unblind_signed_block(chain.T, signed_blinded, payload)
+        self.publish_block(full)
+        self._blinded_payloads.pop(header.block_hash, None)
+
     def sync_committee_contribution(self, slot: int, subcommittee: int,
                                     beacon_block_root: bytes):
         contrib = self.chain.sync_committee_pool.produce_contribution(
@@ -831,3 +884,197 @@ class ApiBackend:
             except ApiError:
                 continue
         return out
+
+    # -- lighthouse analysis / ops extensions (round 3; ref
+    # beacon_node/http_api/src/lib.rs:3925-4521 + watch/src/block_packing)
+
+    def graffiti(self) -> dict:
+        g = getattr(self.chain, "graffiti", b"\x00" * 32)
+        return {"graffiti": "0x" + (g if isinstance(g, bytes)
+                                    else bytes(32)).hex()}
+
+    def merge_readiness(self) -> dict:
+        st = self._resolve_state("head")
+        merged = getattr(st, "latest_execution_payload_header", None) \
+            is not None and \
+            st.latest_execution_payload_header.block_hash != b"\x00" * 32
+        return {"type": "ready" if merged else "not_synced",
+                "current_difficulty": "0",
+                "terminal_total_difficulty":
+                    str(self.chain.spec.terminal_total_difficulty)}
+
+    def eth1_syncing(self) -> dict:
+        svc = self.chain.eth1_service
+        return {"eth1_node_sync_status_percentage": 100.0,
+                "lighthouse_is_cached_and_ready":
+                    bool(svc is not None)}
+
+    def eth1_block_cache(self) -> list[dict]:
+        svc = self.chain.eth1_service
+        blocks = getattr(svc, "block_cache", None) if svc else None
+        if not blocks:
+            return []
+        return [{"number": str(getattr(b, "number", i))}
+                for i, b in enumerate(blocks)]
+
+    def analysis_block_packing(self, start_epoch: int,
+                               end_epoch: int) -> list[dict]:
+        """Per-block attestation packing efficiency: included attester
+        seats vs the seats attesting in the slots the block could pack
+        (watch/src/block_packing)."""
+        p = self.chain.spec.preset
+        head = self.chain.head().head_state
+        head_slot = int(head.slot)
+        epoch_now = head.current_epoch()
+        active = int(((head.validators.activation_epoch <= epoch_now)
+                      & (epoch_now < head.validators.exit_epoch)).sum())
+        seats_per_slot = max(1, active // p.slots_per_epoch)
+        out = []
+        for epoch in range(start_epoch, end_epoch + 1):
+            for s in range(epoch * p.slots_per_epoch,
+                           (epoch + 1) * p.slots_per_epoch):
+                if s > head_slot:
+                    break
+                root = self.chain.block_root_at_slot(s)
+                if root is None:
+                    continue
+                blk = self.chain.store.get_block(root)
+                if blk is None or blk.message.slot != s:
+                    continue
+                atts = blk.message.body.attestations
+                included = sum(
+                    sum(1 for b in a.aggregation_bits if b) for a in atts)
+                # attestable window: the prior epoch of slots (phase0
+                # inclusion window), truncated at genesis
+                window = min(s, p.slots_per_epoch)
+                available = max(1, seats_per_slot * window)
+                out.append({
+                    "slot": str(s),
+                    "block_root": "0x" + root.hex(),
+                    "proposer_index": int(blk.message.proposer_index),
+                    "attestations_included": included,
+                    "attestations_available": available,
+                    "packing_efficiency": min(1.0, included / available)})
+        return out
+
+    def analysis_attestation_performance(self, index: str,
+                                         start_epoch: int,
+                                         end_epoch: int) -> list[dict]:
+        """Per-validator (or global) attestation performance from the
+        participation flags (watch/src/suboptimal_attestations).  Only
+        the head state's previous epoch is reconstructible from live
+        data; the requested range is clamped to it (each record carries
+        the epoch it describes)."""
+        st = self._resolve_state("head")
+        if st.previous_epoch_participation is None:
+            raise ApiError(400, "phase0 unsupported")
+        part = st.previous_epoch_participation
+        n = len(part)
+        if index == "global":
+            ids = range(n)
+        elif index.startswith("0x"):
+            idx = self.get_validator_index(bytes.fromhex(index[2:]))
+            if idx is None:
+                raise ApiError(404, "unknown validator")
+            ids = [idx]
+        else:
+            ids = [int(index)]
+        prev_epoch = max(0, st.current_epoch() - 1)
+        if not (start_epoch <= prev_epoch <= end_epoch):
+            return []
+        out = []
+        for i in ids:
+            if i >= n:
+                raise ApiError(404, "unknown validator")
+            flags = int(part[i])
+            out.append({
+                "index": i,
+                "epoch": int(prev_epoch),
+                "is_active": bool(
+                    st.validators.activation_epoch[i]
+                    <= st.current_epoch() < st.validators.exit_epoch[i]),
+                "received_source": bool(flags & 0b001),
+                "received_target": bool(flags & 0b010),
+                "received_head": bool(flags & 0b100)})
+        return out
+
+    def validator_inclusion_validator(self, epoch: int,
+                                      validator_id: str) -> dict:
+        st = self._resolve_state("head")
+        if st.previous_epoch_participation is None:
+            raise ApiError(400, "phase0 unsupported")
+        if validator_id.startswith("0x"):
+            idx = self.get_validator_index(
+                bytes.fromhex(validator_id[2:]))
+            if idx is None:
+                raise ApiError(404, "unknown validator")
+        else:
+            idx = int(validator_id)
+        if idx >= len(st.previous_epoch_participation):
+            raise ApiError(404, "unknown validator")
+        flags = int(st.previous_epoch_participation[idx])
+        active = bool(st.validators.activation_epoch[idx] <= epoch
+                      < st.validators.exit_epoch[idx])
+        return {
+            "is_slashed": bool(st.validators.slashed[idx]),
+            "is_withdrawable_in_current_epoch": bool(
+                epoch >= st.validators.withdrawable_epoch[idx]),
+            "is_active_unslashed_in_current_epoch": active
+            and not bool(st.validators.slashed[idx]),
+            "is_active_unslashed_in_previous_epoch": active
+            and not bool(st.validators.slashed[idx]),
+            "is_previous_epoch_target_attester": bool(flags & 0b010),
+            "is_previous_epoch_head_attester": bool(flags & 0b100),
+        }
+
+    def fork_choice_heads_weights(self) -> list[dict]:
+        return [{"root": n["block_root"], "weight": n["weight"]}
+                for n in self.debug_fork_choice()["fork_choice_nodes"]]
+
+    def sync_committee_duties_at(self, epoch: int) -> dict:
+        st = self._duties_state(epoch * self.chain.spec.preset
+                                .slots_per_epoch)
+        return {"validator_count": len(st.validators)}
+
+    def weak_subjectivity_checkpoint(self) -> dict:
+        epoch, root = self.chain.finalized_checkpoint()
+        return {"ws_checkpoint": "0x" + root.hex() + ":" + str(epoch),
+                "is_safe": True,
+                "current_epoch": str(self.chain.slot()
+                                     // self.chain.spec.preset
+                                     .slots_per_epoch)}
+
+    def blinded_block_ssz(self, block_id: str) -> bytes:
+        """Stored block in its blinded form (GET blinded_blocks/{id})."""
+        from ..containers.blinded import blind_signed_block
+        from ..ssz import serialize
+        _root, blk = self._resolve_block(block_id)
+        if type(blk).fork_name < ForkName.BELLATRIX:
+            return serialize(type(blk).ssz_type, blk)
+        blinded = blind_signed_block(self.chain.T, blk)
+        return serialize(type(blinded).ssz_type, blinded)
+
+    def ui_validator_metrics(self, indices: list[int]) -> dict:
+        st = self._resolve_state("head")
+        out = {}
+        for i in indices:
+            if i >= len(st.validators):
+                continue
+            flags = int(st.previous_epoch_participation[i]) \
+                if st.previous_epoch_participation is not None else 0
+            out[str(i)] = {
+                "attestation_hits": bin(flags).count("1"),
+                "attestation_misses": 3 - bin(flags).count("1"),
+                "latest_attestation_inclusion_distance": 1}
+        return {"validators": out}
+
+    def ui_validator_info(self, indices: list[int]) -> dict:
+        return {"validators": {
+            str(v["index"]): {"info": v["validator"],
+                              "balance": v["balance"],
+                              "status": v["status"]}
+            for v in self.validators("head", indices)}}
+
+    def peers_connected(self) -> list[dict]:
+        return [p for p in self.node_peers()
+                if p.get("state") == "connected"]

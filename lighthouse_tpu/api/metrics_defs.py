@@ -1,0 +1,164 @@
+"""Metric catalog — the names the rest of the node instruments against.
+
+Equivalent in role to /root/reference/beacon_node/beacon_chain/src/
+metrics.rs (~1,400 LoC of lazy_static definitions): one place declaring
+every metric name + help string, so dashboards can rely on a stable
+inventory.  The generic registry machinery lives in metrics.py; this
+module pre-registers the catalog and offers typed helpers.
+"""
+from __future__ import annotations
+
+from . import metrics
+
+#: name -> (kind, help)
+CATALOG: dict[str, tuple[str, str]] = {
+    # -- block import pipeline (beacon_chain.rs BLOCK_PROCESSING_*) ------
+    "beacon_block_processing_seconds":
+        ("hist", "Full process_block latency"),
+    "beacon_block_processing_gossip_verification_seconds":
+        ("hist", "verify_block_for_gossip latency"),
+    "beacon_block_processing_signature_seconds":
+        ("hist", "Batch signature verification latency"),
+    "beacon_block_processing_state_transition_seconds":
+        ("hist", "per_block_processing + slot advance latency"),
+    "beacon_block_processing_state_root_seconds":
+        ("hist", "tree_hash_root of the post state"),
+    "beacon_block_processing_fork_choice_seconds":
+        ("hist", "fork_choice.on_block latency"),
+    "beacon_block_processing_db_write_seconds":
+        ("hist", "Block + state persistence latency"),
+    "beacon_block_imported_total":
+        ("counter", "Blocks imported"),
+    "beacon_block_production_seconds":
+        ("hist", "produce_block latency"),
+    "beacon_block_production_total": ("counter", "Blocks produced"),
+    "beacon_reorgs_total": ("counter", "Head reorganizations"),
+    "beacon_head_slot": ("gauge", "Canonical head slot"),
+    "beacon_finalized_epoch": ("gauge", "Finalized epoch"),
+    "beacon_justified_epoch": ("gauge", "Justified epoch"),
+    "beacon_head_state_validators_total":
+        ("gauge", "Validator count in the head state"),
+    # -- attestation pipeline -------------------------------------------
+    "beacon_attestation_processing_seconds":
+        ("hist", "Unaggregated attestation verification latency"),
+    "beacon_aggregate_processing_seconds":
+        ("hist", "Aggregate verification latency"),
+    "beacon_attestations_imported_total":
+        ("counter", "Attestations applied to fork choice"),
+    "beacon_attestations_invalid_total":
+        ("counter", "Attestations rejected"),
+    "beacon_batch_verify_signature_sets":
+        ("hist", "Signature sets per BLS batch call"),
+    "beacon_batch_verify_seconds":
+        ("hist", "verify_signature_sets latency"),
+    # -- gossip plane (lighthouse_network metrics) ----------------------
+    "gossipsub_messages_received_total":
+        ("counter", "Gossip data messages received"),
+    "gossipsub_messages_published_total":
+        ("counter", "Gossip data messages published"),
+    "gossipsub_duplicates_dropped_total":
+        ("counter", "Seen-cache duplicate drops"),
+    "gossipsub_validation_accept_total":
+        ("counter", "Gossip accepted"),
+    "gossipsub_validation_ignore_total":
+        ("counter", "Gossip ignored"),
+    "gossipsub_validation_reject_total":
+        ("counter", "Gossip rejected"),
+    "gossipsub_mesh_peers": ("gauge", "Mesh size across topics"),
+    "gossipsub_idontwant_sent_total":
+        ("counter", "IDONTWANT control messages sent"),
+    "libp2p_peers": ("gauge", "Connected libp2p peers"),
+    "libp2p_peer_connect_total": ("counter", "Peer connections"),
+    "libp2p_peer_disconnect_total": ("counter", "Peer disconnects"),
+    "libp2p_rpc_requests_total": ("counter", "Req/resp requests served"),
+    "libp2p_rpc_errors_total": ("counter", "Req/resp error responses"),
+    # -- sync (network/src/sync metrics) --------------------------------
+    "sync_range_batches_downloaded_total":
+        ("counter", "Range-sync batches downloaded"),
+    "sync_range_blocks_imported_total":
+        ("counter", "Blocks imported by range sync"),
+    "sync_backfill_batches_total":
+        ("counter", "Backfill batches processed"),
+    "sync_parent_lookups_total": ("counter", "Parent-root lookups"),
+    "sync_state": ("gauge", "0 synced / 1 range-syncing"),
+    # -- beacon processor (beacon_processor/src/metrics) ----------------
+    "beacon_processor_work_events_total":
+        ("counter", "Work items submitted"),
+    "beacon_processor_workers_active": ("gauge", "Busy workers"),
+    "beacon_processor_queue_length": ("gauge", "Pending work items"),
+    "beacon_processor_reprocess_total":
+        ("counter", "Requeued early-arriving work"),
+    # -- op pool ---------------------------------------------------------
+    "op_pool_attestations": ("gauge", "Attestations pooled"),
+    "op_pool_slashings": ("gauge", "Slashings pooled"),
+    "op_pool_exits": ("gauge", "Voluntary exits pooled"),
+    # -- store ------------------------------------------------------------
+    "store_hot_db_ops_total": ("counter", "Hot DB operations"),
+    "store_cold_db_ops_total": ("counter", "Freezer operations"),
+    "store_migration_seconds": ("hist", "migrate_database latency"),
+    "store_cold_state_replay_seconds":
+        ("hist", "Cold-state reconstruction latency"),
+    "store_state_cache_hits_total": ("counter", "State-cache hits"),
+    "store_state_cache_misses_total": ("counter", "State-cache misses"),
+    # -- crypto hot spots -------------------------------------------------
+    "bls_batch_verify_sigs": ("hist", "Signatures per device batch"),
+    "bls_device_pairing_seconds": ("hist", "Device pairing-check latency"),
+    "tree_hash_root_seconds": ("hist", "BeaconState tree_hash latency"),
+    "kzg_blob_verification_seconds": ("hist", "Blob batch verify latency"),
+    # -- execution layer --------------------------------------------------
+    "execution_layer_new_payload_seconds":
+        ("hist", "engine_newPayload round-trip"),
+    "execution_layer_forkchoice_seconds":
+        ("hist", "engine_forkchoiceUpdated round-trip"),
+    "execution_layer_payload_source_builder_total":
+        ("counter", "Payloads taken from the builder"),
+    "execution_layer_payload_source_local_total":
+        ("counter", "Locally-built payloads"),
+    # -- validator monitor / block times ---------------------------------
+    "validator_monitor_attestation_hits_total":
+        ("counter", "Monitored validators' timely attestations"),
+    "validator_monitor_missed_blocks_total":
+        ("counter", "Monitored validators' missed proposals"),
+    "beacon_block_observed_delay_seconds":
+        ("hist", "Slot start -> block first observed"),
+    "beacon_block_imported_delay_seconds":
+        ("hist", "Observed -> imported"),
+    "beacon_block_head_delay_seconds":
+        ("hist", "Imported -> became head"),
+    # -- system health ----------------------------------------------------
+    "process_cpu_percent": ("gauge", "Process CPU utilisation"),
+    "process_resident_memory_bytes": ("gauge", "RSS"),
+    "system_load_1m": ("gauge", "1-minute load average"),
+    "system_disk_free_bytes": ("gauge", "Free disk on the data volume"),
+}
+
+
+def register_catalog() -> int:
+    """Force-register every catalog entry (so /metrics exposes the full
+    inventory even before first use); returns the count."""
+    for name, (kind, help_) in CATALOG.items():
+        if kind == "counter":
+            metrics.inc_counter(name, help_, 0)
+        elif kind == "gauge":
+            metrics.set_gauge(name, 0, help_)
+        else:
+            metrics._get(metrics.Histogram, name, help_)
+    return len(CATALOG)
+
+
+def timed(name: str):
+    """Catalog-checked timer."""
+    assert name in CATALOG, f"unknown metric {name}"
+    return metrics.timer(name, CATALOG[name][1])
+
+
+def count(name: str, amount: float = 1) -> None:
+    metrics.inc_counter(name, CATALOG.get(name, ("", name))[1], amount)
+
+
+def gauge(name: str, value: float) -> None:
+    metrics.set_gauge(name, value, CATALOG.get(name, ("", name))[1])
+
+
+def observe(name: str, value: float) -> None:
+    metrics.observe(name, value, CATALOG.get(name, ("", name))[1])

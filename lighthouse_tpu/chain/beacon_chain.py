@@ -135,6 +135,7 @@ class BeaconChain:
         from .data_availability import DataAvailabilityChecker
         self.data_availability_checker = DataAvailabilityChecker(self.T)
         self.block_times: dict[bytes, dict] = {}
+        self._block_times_cache = None     # lazy (block_times_cache prop)
         # proposer preparation + MEV builder (execution_layer/src/lib.rs:807
         # get_payload builder path; validator registrations forwarded to the
         # builder, fee recipients applied to local payloads)
@@ -258,15 +259,25 @@ class BeaconChain:
                       proposal_already_verified: bool = False) -> bytes:
         """Full import pipeline (beacon_chain.rs:3089): signatures (batched)
         -> state transition -> payload -> fork choice -> store -> head."""
+        from ..api import metrics_defs as M
         block = signed_block.message
         block_root = htr(block)
         if self.fork_choice.contains_block(block_root):
             return block_root
         if not self.fork_choice.contains_block(block.parent_root):
             raise BlockError(PARENT_UNKNOWN, block.parent_root.hex())
-        sv = blk_verify.into_signature_verified(
-            self, signed_block, block_root, proposal_already_verified)
-        ep = blk_verify.into_execution_pending(self, sv)
+        self.block_times_cache.on_observed(block_root, block.slot)
+        with M.timed("beacon_block_processing_seconds"):
+            with M.timed("beacon_block_processing_signature_seconds"):
+                sv = blk_verify.into_signature_verified(
+                    self, signed_block, block_root,
+                    proposal_already_verified)
+            with M.timed(
+                    "beacon_block_processing_state_transition_seconds"):
+                ep = blk_verify.into_execution_pending(self, sv)
+            return self._finish_process_block(block, block_root, ep)
+
+    def _finish_process_block(self, block, block_root: bytes, ep) -> bytes:
         # deneb+: blob availability gate (data_availability_checker.rs)
         commitments = getattr(block.body, "blob_kzg_commitments", None)
         if commitments:
@@ -277,6 +288,15 @@ class BeaconChain:
                 raise BlockError(AVAILABILITY_PENDING, block_root.hex())
             ep = ready
         return self.import_block(ep)
+
+    @property
+    def block_times_cache(self):
+        if self._block_times_cache is None:
+            from .block_times_cache import BlockTimesCache
+            self._block_times_cache = BlockTimesCache(
+                int(self.genesis_state.genesis_time),
+                self.spec.seconds_per_slot)
+        return self._block_times_cache
 
     def process_blob_sidecar(self, sidecar) -> bytes | None:
         """Gossip blob intake; imports the parent block when it completes.
@@ -382,6 +402,7 @@ class BeaconChain:
         status = {"valid": ExecutionStatus.VALID,
                   "optimistic": ExecutionStatus.OPTIMISTIC,
                   "irrelevant": ExecutionStatus.IRRELEVANT}[ep.payload_status]
+        from ..api import metrics_defs as M
         current_slot = max(self.slot(), block.slot)
         delay = None
         if self.slot_clock.now() == block.slot:
@@ -389,6 +410,8 @@ class BeaconChain:
         self.block_times[block_root] = {
             "slot": block.slot, "delay": delay,
             "observed_slot": self.slot()}
+        self.block_times_cache.on_imported(block_root, block.slot)
+        M.count("beacon_block_imported_total")
         with self._lock:
             self.fork_choice.on_block(current_slot, block, block_root, state,
                                       block_delay_seconds=delay,
@@ -532,6 +555,17 @@ class BeaconChain:
                 reorg = old.head_block_root != (
                     head_block.message.parent_root if head_block else None)
                 self.canonical_head = new_head
+                from ..api import metrics_defs as M
+                if head_block is not None:
+                    self.block_times_cache.on_became_head(
+                        head_root, head_block.message.slot)
+                M.gauge("beacon_head_slot", int(head_state.slot))
+                M.gauge("beacon_finalized_epoch",
+                        int(self.fork_choice.finalized_checkpoint[0]))
+                M.gauge("beacon_justified_epoch",
+                        int(self.fork_choice.justified_checkpoint[0]))
+                if reorg:
+                    M.count("beacon_reorgs_total")
                 self.events.emit("head", {
                     "slot": head_state.slot, "block": head_root,
                     "previous": old.head_block_root})

@@ -1,0 +1,54 @@
+"""Host/process health gauges (common/system_health equivalent).
+
+Pure /proc + os.statvfs — no psutil dependency.  `snapshot()` returns
+the UI-facing dict and refreshes the prometheus gauges.
+"""
+from __future__ import annotations
+
+import os
+import resource
+
+from ..api import metrics_defs
+
+
+def _meminfo() -> dict[str, int]:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2 and parts[0].endswith(":"):
+                    out[parts[0][:-1]] = int(parts[1]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+def snapshot(data_dir: str = "/") -> dict:
+    la1 = la5 = la15 = 0.0
+    try:
+        la1, la5, la15 = os.getloadavg()
+    except OSError:
+        pass
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    mem = _meminfo()
+    try:
+        st = os.statvfs(data_dir)
+        disk_free = st.f_bavail * st.f_frsize
+        disk_total = st.f_blocks * st.f_frsize
+    except OSError:
+        disk_free = disk_total = 0
+    out = {
+        "sys_loadavg_1": la1, "sys_loadavg_5": la5, "sys_loadavg_15": la15,
+        "sys_virt_mem_total": mem.get("MemTotal", 0),
+        "sys_virt_mem_available": mem.get("MemAvailable", 0),
+        "app_mem_process_resident_set_size": rss,
+        "disk_node_bytes_total": disk_total,
+        "disk_node_bytes_free": disk_free,
+        "network_node_bytes_total_received": 0,
+        "network_node_bytes_total_transmit": 0,
+    }
+    metrics_defs.gauge("system_load_1m", la1)
+    metrics_defs.gauge("process_resident_memory_bytes", rss)
+    metrics_defs.gauge("system_disk_free_bytes", disk_free)
+    return out

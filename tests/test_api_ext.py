@@ -180,3 +180,76 @@ def test_expected_withdrawals_route():
                               "amount"}
     finally:
         srv.stop()
+
+
+def test_round3_analysis_and_ops_routes(api):
+    h, srv = api
+    # graffiti / readiness / eth1 / ws
+    assert "graffiti" in _get(srv, "/lighthouse/ui/graffiti")["data"]
+    assert "graffiti" in _get(srv, "/eth/v1/node/graffiti")["data"]
+    mr = _get(srv, "/lighthouse/merge_readiness")["data"]
+    assert mr["type"] in ("ready", "not_synced")
+    _get(srv, "/lighthouse/eth1/syncing")
+    _get(srv, "/lighthouse/eth1/block_cache")
+    ws = _get(srv, "/eth/v1/beacon/weak_subjectivity")["data"]
+    assert ws["ws_checkpoint"].startswith("0x")
+    assert _get(srv, "/lighthouse/finalized_checkpoint")["data"]
+    # packing + attestation performance analysis
+    packing = _get(srv, "/lighthouse/analysis/block_packing"
+                        "?start_epoch=1&end_epoch=2")["data"]
+    assert packing and all(0 <= p["packing_efficiency"] <= 1
+                           for p in packing)
+    assert _get(srv, "/lighthouse/analysis/block_packing_efficiency"
+                     "?start_epoch=1&end_epoch=1")["data"]
+    perf = _get(srv, "/lighthouse/analysis/attestation_performance/3"
+                     "?start_epoch=0&end_epoch=99")["data"]
+    assert perf[0]["index"] == 3 and "received_target" in perf[0]
+    # per-validator inclusion
+    inc = _get(srv, "/lighthouse/validator_inclusion/2/5")["data"]
+    assert "is_previous_epoch_target_attester" in inc
+    # fork-choice heads + connected peers + validator_count
+    _get(srv, "/lighthouse/fork_choice/heads")
+    _get(srv, "/lighthouse/peers/connected")
+    vc = _get(srv, "/eth/v1/beacon/states/head/validator_count")["data"]
+    assert int(vc["active_ongoing"]) == 32
+    # log tail (emit one record through the buffered logger first)
+    import logging
+    from lighthouse_tpu.utils.log_buffer import global_log_buffer
+    global_log_buffer()
+    logging.getLogger("lighthouse_tpu.test").info("round3 route test")
+    tail = _get(srv, "/lighthouse/logs/tail?n=10")["data"]
+    assert any("round3 route test" in e["msg"] for e in tail)
+
+
+def test_round3_post_routes(api):
+    h, srv = api
+    # POST liveness
+    out = _post(srv, "/eth/v1/validator/liveness/2", ["0", "1", "9"])
+    data = out["data"]
+    assert len(data) == 3 and all("is_live" in d for d in data)
+    # ui validator metrics/info
+    vm = _post(srv, "/lighthouse/ui/validator_metrics",
+               {"indices": [0, 1]})["data"]["validators"]
+    assert set(vm) == {"0", "1"}
+    vi = _post(srv, "/lighthouse/ui/validator_info",
+               {"indices": [2]})["data"]["validators"]
+    assert "2" in vi and vi["2"]["status"]
+    # POST validator_identities
+    ids = _post(srv, "/eth/v1/beacon/states/head/validator_identities",
+                ["4"])["data"]
+    assert len(ids) == 1
+    # db ops
+    assert _post(srv, "/lighthouse/database/reconstruct", {})["data"]
+    assert _post(srv, "/lighthouse/compaction", {})["data"]
+
+
+def test_blinded_block_get_route(api):
+    h, srv = api
+    # altair chain: blinded GET falls back to the full block SSZ
+    raw = urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}/eth/v1/beacon/blinded_blocks/head"
+    ).read()
+    from lighthouse_tpu.ssz import deserialize
+    fork = h.chain.spec.fork_name_at_slot(h.chain.head().head_state.slot)
+    blk = deserialize(h.chain.T.SignedBeaconBlock[fork].ssz_type, raw)
+    assert blk.message.slot == h.chain.head().head_state.slot
