@@ -221,8 +221,10 @@ def _run_beacon_node(spec, args):
             cfg.checkpoint_sync_block = \
                 open(args.checkpoint_block, "rb").read()
     if args.dump_config:
+        from .specs.networks import spec_to_config
         out = dict(vars(cfg))
         out["network"] = vars(cfg.network)
+        out["spec"] = spec_to_config(spec)
         for k, v in out.items():
             if isinstance(v, bytes):
                 out[k] = "0x" + v.hex()

@@ -941,9 +941,17 @@ class ApiBackend:
                 blk = self.chain.store.get_block(root)
                 if blk is None or blk.message.slot != s:
                     continue
-                atts = blk.message.body.attestations
-                included = sum(
-                    sum(1 for b in a.aggregation_bits if b) for a in atts)
+                # dedupe seats per (slot, committee): overlapping
+                # aggregates must not double-count attesters
+                union: dict[tuple, int] = {}
+                for a in blk.message.body.attestations:
+                    bits = 0
+                    for bi, b in enumerate(a.aggregation_bits):
+                        if b:
+                            bits |= 1 << bi
+                    key = (int(a.data.slot), int(a.data.index))
+                    union[key] = union.get(key, 0) | bits
+                included = sum(bin(v).count("1") for v in union.values())
                 # attestable window: the prior epoch of slots (phase0
                 # inclusion window), truncated at genesis
                 window = min(s, p.slots_per_epoch)

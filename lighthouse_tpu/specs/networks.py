@@ -11,7 +11,7 @@ from __future__ import annotations
 import os
 
 from .chain_spec import ChainSpec, mainnet_spec, minimal_spec
-from .presets import MAINNET_PRESET, MINIMAL_PRESET
+from .presets import GNOSIS_PRESET, MAINNET_PRESET, MINIMAL_PRESET
 
 
 def _v(hexstr: str) -> bytes:
@@ -46,11 +46,41 @@ def holesky_spec() -> ChainSpec:
     )
 
 
+def gnosis_spec() -> ChainSpec:
+    """Gnosis chain (consensus/types/src/chain_spec.rs:933 gnosis())."""
+    return ChainSpec(
+        preset=GNOSIS_PRESET,
+        config_name="gnosis",
+        seconds_per_slot=5,
+        genesis_delay=6000,
+        min_genesis_time=1638968400,
+        min_genesis_active_validator_count=4096,
+        churn_limit_quotient=4096,
+        max_per_epoch_activation_churn_limit=2,
+        deposit_chain_id=100,
+        deposit_network_id=100,
+        deposit_contract_address=bytes.fromhex(
+            "0b98057ea310f4d31f2a452b414647007d1645d9"),
+        eth1_follow_distance=1024,
+        seconds_per_eth1_block=6,
+        terminal_total_difficulty=(
+            8626000000000000000000058750000000000000000000),
+        genesis_fork_version=_v("00000064"),
+        altair_fork_version=_v("01000064"), altair_fork_epoch=512,
+        bellatrix_fork_version=_v("02000064"),
+        bellatrix_fork_epoch=385536,
+        capella_fork_version=_v("03000064"), capella_fork_epoch=648704,
+        deneb_fork_version=_v("04000064"), deneb_fork_epoch=889856,
+        electra_fork_version=_v("05000064"),
+    )
+
+
 NETWORKS = {
     "mainnet": mainnet_spec,
     "minimal": minimal_spec,
     "sepolia": sepolia_spec,
     "holesky": holesky_spec,
+    "gnosis": gnosis_spec,
 }
 
 
@@ -100,6 +130,10 @@ _YAML_KEYS = {
 }
 
 
+_PRESETS_BY_BASE = {"mainnet": MAINNET_PRESET, "minimal": MINIMAL_PRESET,
+                    "gnosis": GNOSIS_PRESET}
+
+
 def load_testnet_dir(path: str) -> ChainSpec:
     """Custom network from a testnet directory holding ``config.yaml``
     (consensus-configs format); PRESET_BASE selects the preset."""
@@ -107,13 +141,34 @@ def load_testnet_dir(path: str) -> ChainSpec:
     cfg_path = os.path.join(path, "config.yaml")
     with open(cfg_path) as f:
         raw = yaml.safe_load(f)
-    preset = (MINIMAL_PRESET if str(raw.get("PRESET_BASE", "mainnet"))
-              .strip("'\"") == "minimal" else MAINNET_PRESET)
+    base = str(raw.get("PRESET_BASE", "mainnet")).strip("'\"")
+    preset = _PRESETS_BY_BASE.get(base, MAINNET_PRESET)
     kw = {"preset": preset}
     for key, (field, parse) in _YAML_KEYS.items():
         if key in raw:
             kw[field] = parse(raw[key])
     return ChainSpec(**kw)
+
+
+def spec_to_config(spec: ChainSpec) -> dict:
+    """ChainSpec -> the standard config.yaml key dict — the inverse of
+    load_testnet_dir over _YAML_KEYS (clap_utils::check_dump_configs
+    round-trip role).  Values use the canonical upstream text forms."""
+    out = {"PRESET_BASE": spec.preset.name}
+    for key, (field, parse) in _YAML_KEYS.items():
+        v = getattr(spec, field, None)
+        if v is None:
+            continue
+        if isinstance(v, bytes):
+            v = "0x" + v.hex()
+        out[key] = v
+    return out
+
+
+def dump_config_yaml(spec: ChainSpec, path: str) -> None:
+    import yaml
+    with open(path, "w") as f:
+        yaml.safe_dump(spec_to_config(spec), f, sort_keys=False)
 
 
 def testnet_genesis_state(path: str, spec: ChainSpec):

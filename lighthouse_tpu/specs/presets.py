@@ -143,4 +143,21 @@ MINIMAL_PRESET = Preset(
     max_withdrawal_requests_per_payload=2,
 )
 
-PRESETS = {"mainnet": MAINNET_PRESET, "minimal": MINIMAL_PRESET}
+# Gnosis (consensus/types/src/eth_spec.rs:520-580 GnosisEthSpec):
+# mainnet shapes except 16-slot epochs, 512-epoch sync periods, 8
+# withdrawals per payload, and the faster reward curve in ChainSpec
+GNOSIS_PRESET = Preset(
+    name="gnosis",
+    slots_per_epoch=16,
+    max_committees_per_slot=64,
+    target_committee_size=128,
+    max_validators_per_committee=2048,
+    shuffle_round_count=90,
+    base_reward_factor=25,
+    epochs_per_sync_committee_period=512,
+    max_withdrawals_per_payload=8,
+    max_validators_per_withdrawals_sweep=8192,
+)
+
+PRESETS = {"mainnet": MAINNET_PRESET, "minimal": MINIMAL_PRESET,
+           "gnosis": GNOSIS_PRESET}

@@ -56,6 +56,13 @@ class WatchMonitor:
             validator INTEGER PRIMARY KEY, proposals INTEGER);
         CREATE TABLE IF NOT EXISTS blockprint (
             slot INTEGER PRIMARY KEY, best_guess TEXT);
+        CREATE TABLE IF NOT EXISTS block_packing (
+            slot INTEGER PRIMARY KEY, included INTEGER,
+            available INTEGER, efficiency REAL);
+        CREATE TABLE IF NOT EXISTS suboptimal_attestations (
+            epoch INTEGER, validator INTEGER, source INTEGER,
+            target INTEGER, head INTEGER,
+            PRIMARY KEY (epoch, validator));
         """)
         self._last_slot = -1
 
@@ -96,11 +103,37 @@ class WatchMonitor:
                     "ON CONFLICT(validator) DO UPDATE SET "
                     "proposals = proposals + 1",
                     (blk.message.proposer_index,))
+                self._record_packing(slot, body, head.head_state)
                 added += 1
             self._last_slot = head.head_state.slot
             self._update_epoch_summary(head.head_state)
             self._db.commit()
         return added
+
+    def _record_packing(self, slot: int, body, head_state) -> None:
+        """watch/src/block_packing: included attester seats vs the seats
+        of the attestable window (prior epoch of slots).  Seats are
+        deduplicated per (slot, committee) — overlapping aggregates for
+        the same committee must not double-count."""
+        from ..state_transition.helpers import is_active_validator_mask
+        p = self.chain.spec.preset
+        active = int(is_active_validator_mask(
+            head_state, head_state.current_epoch()).sum())
+        seats_per_slot = max(1, active // p.slots_per_epoch)
+        union: dict[tuple, int] = {}
+        for a in body.attestations:
+            key = (int(a.data.slot), int(a.data.index))
+            bits = 0
+            for i, b in enumerate(a.aggregation_bits):
+                if b:
+                    bits |= 1 << i
+            union[key] = union.get(key, 0) | bits
+        included = sum(bin(v).count("1") for v in union.values())
+        available = max(1, seats_per_slot * min(slot, p.slots_per_epoch))
+        self._db.execute(
+            "INSERT OR REPLACE INTO block_packing VALUES (?,?,?,?)",
+            (slot, included, available,
+             min(1.0, included / available)))
 
     def _update_epoch_summary(self, state) -> None:
         import numpy as np
@@ -114,6 +147,7 @@ class WatchMonitor:
         if state.fork_name >= ForkName.ALTAIR:
             mask = _unslashed_participating_mask(state, 1, epoch)
             target = int(state.validators.effective_balance[mask].sum())
+            self._record_suboptimal(state, epoch)
         else:
             target = 0
         self._db.execute(
@@ -122,6 +156,35 @@ class WatchMonitor:
              target / active if active else 0.0,
              state.current_justified_checkpoint.epoch,
              state.finalized_checkpoint.epoch))
+
+    def _record_suboptimal(self, state, epoch: int) -> None:
+        """watch/src/suboptimal_attestations: per-validator flag rows for
+        every ACTIVE validator that missed source, target or head in the
+        previous epoch (optimal attesters are not stored — the
+        reference's space discipline).  The epoch's rows are rebuilt
+        wholesale: participation keeps accruing through the inclusion
+        window, so a validator recorded suboptimal early must drop out
+        once its late attestation lands.  Only the head's previous epoch
+        is reconstructible — `missing_epoch_summaries` exposes gaps from
+        infrequent polling so 'no rows' is distinguishable from 'all
+        optimal'."""
+        import numpy as np
+        from ..state_transition.helpers import is_active_validator_mask
+        part = state.previous_epoch_participation
+        if part is None:
+            return
+        part = np.asarray(part)
+        active = np.asarray(is_active_validator_mask(state, epoch))
+        suboptimal = active & ((part & 0b111) != 0b111)
+        self._db.execute(
+            "DELETE FROM suboptimal_attestations WHERE epoch = ?",
+            (int(epoch),))
+        for i in np.flatnonzero(suboptimal):
+            flags = int(part[i])
+            self._db.execute(
+                "INSERT INTO suboptimal_attestations VALUES (?,?,?,?,?)",
+                (int(epoch), int(i), flags & 1, (flags >> 1) & 1,
+                 (flags >> 2) & 1))
 
     # -- queries (watch/src/server) ------------------------------------------
 
@@ -170,6 +233,46 @@ class WatchMonitor:
                 "AND ?", (start_slot, end_slot))}
         return [s for s in range(start_slot, end_slot + 1) if s not in have]
 
+    def block_packing(self, start_slot: int, end_slot: int):
+        with self._lock:
+            return [{"slot": r[0], "included": r[1], "available": r[2],
+                     "efficiency": r[3]}
+                    for r in self._db.execute(
+                        "SELECT slot, included, available, efficiency "
+                        "FROM block_packing WHERE slot BETWEEN ? AND ? "
+                        "ORDER BY slot", (start_slot, end_slot))]
+
+    def suboptimal_at_epoch(self, epoch: int):
+        """All suboptimal attesters for an epoch (missed flags)."""
+        with self._lock:
+            return [{"validator_index": r[0], "source": bool(r[1]),
+                     "target": bool(r[2]), "head": bool(r[3])}
+                    for r in self._db.execute(
+                        "SELECT validator, source, target, head FROM "
+                        "suboptimal_attestations WHERE epoch = ? "
+                        "ORDER BY validator", (epoch,))]
+
+    def missing_epoch_summaries(self, start_epoch: int,
+                                end_epoch: int) -> list[int]:
+        """Epochs with no summary row — update() only reconstructs the
+        head's previous epoch, so infrequent polling leaves gaps that
+        must be distinguishable from 'all validators optimal'."""
+        with self._lock:
+            have = {r[0] for r in self._db.execute(
+                "SELECT epoch FROM epoch_summaries WHERE epoch BETWEEN "
+                "? AND ?", (start_epoch, end_epoch))}
+        return [e for e in range(start_epoch, end_epoch + 1)
+                if e not in have]
+
+    def validator_attestation_history(self, validator: int):
+        with self._lock:
+            return [{"epoch": r[0], "source": bool(r[1]),
+                     "target": bool(r[2]), "head": bool(r[3])}
+                    for r in self._db.execute(
+                        "SELECT epoch, source, target, head FROM "
+                        "suboptimal_attestations WHERE validator = ? "
+                        "ORDER BY epoch", (validator,))]
+
 
 class WatchServer:
     """HTTP front for the monitor DB (watch/src/server in the reference):
@@ -217,6 +320,9 @@ class WatchServer:
                             {"slot": r[0], "proposer_index": r[1],
                              "attestations": r[2],
                              "sync_participation": r[3]} for r in rows]})
+                    if url.path == "/v1/blocks/packing":
+                        return self._json(200, {"data": mon.block_packing(
+                            int(q["start"][0]), int(q["end"][0]))})
                     if url.path.startswith("/v1/blocks/"):
                         slot = int(url.path.rsplit("/", 1)[1])
                         rows = mon.block_rewards_range(slot, slot)
@@ -230,6 +336,11 @@ class WatchServer:
                             {"validator_index": v, "blocks": n}
                             for v, n in mon.top_proposers(
                                 int(q.get("limit", [10])[0]))]})
+                    if url.path.startswith("/v1/epochs/") and \
+                            url.path.endswith("/suboptimal"):
+                        epoch = int(url.path.split("/")[3])
+                        return self._json(200, {
+                            "data": mon.suboptimal_at_epoch(epoch)})
                     if url.path.startswith("/v1/epochs/"):
                         epoch = int(url.path.rsplit("/", 1)[1])
                         part = mon.participation(epoch)
@@ -250,6 +361,11 @@ class WatchServer:
                     if url.path == "/v1/slots/missed":
                         return self._json(200, {"data": mon.missed_slots(
                             int(q["start"][0]), int(q["end"][0]))})
+                    if url.path.startswith("/v1/validators/") and \
+                            url.path.endswith("/attestations"):
+                        v = int(url.path.split("/")[3])
+                        return self._json(200, {
+                            "data": mon.validator_attestation_history(v)})
                     return self._json(404, {"message": "route not found"})
                 except Exception as e:
                     return self._json(400, {"message": repr(e)})
