@@ -15,7 +15,7 @@ import sys
 import time
 from dataclasses import dataclass, field
 
-from ..api import ApiBackend
+from ..api import ApiBackend, BeaconApiServer
 from ..chain import BeaconChainHarness
 from ..crypto import bls
 from ..network import NetworkService
@@ -23,6 +23,7 @@ from ..specs import minimal_spec
 from ..validator_client import (
     BeaconNodeFallback, ValidatorClient, ValidatorStore,
 )
+from ..validator_client.http_client import BeaconNodeHttpClient
 
 
 @dataclass
@@ -31,6 +32,8 @@ class LocalNode:
     network: NetworkService
     backend: ApiBackend
     vc: ValidatorClient | None = None
+    api_server: object | None = None     # BeaconApiServer in HTTP mode
+    dead: bool = False
 
 
 class GossipingBackend(ApiBackend):
@@ -64,10 +67,18 @@ class CheckResult:
 class LocalNetwork:
     """node_test_rig LocalNetwork equivalent."""
 
-    def __init__(self, spec, node_count: int, validator_count: int = 64):
+    def __init__(self, spec, node_count: int, validator_count: int = 64,
+                 use_http: bool = False):
+        """`use_http=True` drives every VC through a REAL per-node HTTP
+        API server (BeaconNodeHttpClient -> BeaconApiServer -> backend),
+        with every OTHER node's URL as a fallback — the reference's
+        fallback_sim topology; block publication then takes the real
+        POST /eth/v1/beacon/blocks path (publish_blocks.rs role) instead
+        of an in-process shortcut."""
         bls.set_backend("fake")
         self.spec = spec
         self.validator_count = validator_count
+        self.use_http = use_http
         self.nodes: list[LocalNode] = []
         first_port = None
         for i in range(node_count):
@@ -76,6 +87,9 @@ class LocalNetwork:
             backend = GossipingBackend(h.chain, net)
             net.start()
             node = LocalNode(h, net, backend)
+            if use_http:
+                node.api_server = BeaconApiServer(backend)
+                node.api_server.start()
             self.nodes.append(node)
             if first_port is None:
                 first_port = net.port
@@ -90,53 +104,103 @@ class LocalNetwork:
             hi = validator_count if i == node_count - 1 else (i + 1) * per
             for sk in node.harness.secret_keys[lo:hi]:
                 store.add_validator(sk)
-            node.vc = ValidatorClient(spec, store,
-                                      BeaconNodeFallback([node.backend]))
+            if use_http:
+                # own node first, every other node as failover
+                order = [node] + [n for n in self.nodes if n is not node]
+                clients = [BeaconNodeHttpClient(
+                    f"http://127.0.0.1:{n.api_server.port}", spec,
+                    timeout=5.0) for n in order]
+                node.vc = ValidatorClient(spec, store,
+                                          BeaconNodeFallback(clients))
+            else:
+                node.vc = ValidatorClient(
+                    spec, store, BeaconNodeFallback([node.backend]))
+
+    def kill_node(self, i: int) -> None:
+        """Fault injection (fallback_sim.rs role): the node's API server
+        and network die.  Its VC KEEPS RUNNING — in HTTP mode its duties
+        fail over to the surviving nodes' URLs, which is the behavior
+        the fallback simulation exists to prove."""
+        node = self.nodes[i]
+        node.dead = True
+        if node.api_server is not None:
+            node.api_server.stop()
+        node.network.stop()
+
+    @property
+    def live_nodes(self) -> list[LocalNode]:
+        live = [n for n in self.nodes if not n.dead]
+        if not live:
+            raise RuntimeError("no live nodes left in the simulation")
+        return live
 
     def _wait_convergence(self, timeout: float = 5.0) -> None:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            heads = {n.harness.chain.recompute_head() for n in self.nodes}
+            heads = {n.harness.chain.recompute_head()
+                     for n in self.live_nodes}
             if len(heads) == 1:
                 return
             time.sleep(0.02)
 
+    def _run_duty(self, node: LocalNode, fn, *args) -> None:
+        """Dead/HTTP duty policy in ONE place: a dead node's VC runs
+        only when HTTP failover exists, and only a dead node's errors
+        are swallowed — a live node's duty failure must stay loud."""
+        if node.dead:
+            if not self.use_http:
+                return                 # no failover path without HTTP
+            try:
+                fn(*args)
+            except Exception:
+                return                 # dead-primary hiccup: next slot
+        else:
+            fn(*args)
+
     def run_slots(self, num_slots: int) -> None:
         """Each slot mirrors the real duty schedule: propose at 0s,
         attest + sync-sign at slot/3 (after block propagation),
-        aggregate at 2*slot/3."""
+        aggregate at 2*slot/3.  A dead node's chain stops, but its VC
+        keeps running — in HTTP mode its duties fail over to the
+        surviving nodes' APIs (fallback_sim behavior)."""
+        def propose(node, slot):
+            vc = node.vc
+            epoch = slot // self.spec.preset.slots_per_epoch
+            if epoch not in vc._duties or epoch + 1 not in vc._duties:
+                vc.update_duties(epoch)
+            vc.propose_if_due(slot)
+
+        def attest(node, slot):
+            node.vc.attest(slot)
+            node.vc.sync_committee_duty(slot)
+
         for _ in range(num_slots):
-            for node in self.nodes:
+            for node in self.live_nodes:
                 node.harness.advance_slot()
-            slot = self.nodes[0].harness.chain.slot()
+            slot = self.live_nodes[0].harness.chain.slot()
             for node in self.nodes:
-                vc = node.vc
-                epoch = slot // self.spec.preset.slots_per_epoch
-                if epoch not in vc._duties or epoch + 1 not in vc._duties:
-                    vc.update_duties(epoch)
-                vc.propose_if_due(slot)
+                self._run_duty(node, propose, node, slot)
             self._wait_convergence()
             for node in self.nodes:
-                node.vc.attest(slot)
-                node.vc.sync_committee_duty(slot)
+                self._run_duty(node, attest, node, slot)
             for node in self.nodes:
-                node.vc.aggregate(slot)
+                self._run_duty(node, node.vc.aggregate, slot)
             self._wait_convergence()
 
     # -- checks (testing/simulator/src/checks.rs) ----------------------------
 
     def checks(self, min_epochs: int) -> list[CheckResult]:
         out = []
-        heads = {n.harness.chain.head().head_block_root
-                 for n in self.nodes}
+        live = self.live_nodes
+        heads = {n.harness.chain.head().head_block_root for n in live}
         out.append(CheckResult("all_nodes_agree_on_head", len(heads) == 1,
                                f"{len(heads)} distinct heads"))
-        slot = self.nodes[0].harness.chain.slot()
-        head_slot = self.nodes[0].harness.chain.head().head_state.slot
+        slot = live[0].harness.chain.slot()
+        head_slot = live[0].harness.chain.head().head_state.slot
         out.append(CheckResult(
             "liveness", head_slot >= slot - 1,
             f"head {head_slot} vs clock {slot}"))
-        fin = self.nodes[0].harness.chain.finalized_checkpoint()[0]
+        fin = live[0].harness.chain.finalized_checkpoint()[0]
         out.append(CheckResult(
             "finalization", fin >= max(0, min_epochs - 2),
             f"finalized epoch {fin}"))
@@ -145,7 +209,7 @@ class LocalNetwork:
             "all_nodes_proposed", all(b > 0 for b in blocks_per_node),
             f"{blocks_per_node}"))
         # sync-aggregate participation on recent blocks
-        chain = self.nodes[0].harness.chain
+        chain = live[0].harness.chain
         body = chain.head().head_block.message.body
         if hasattr(body, "sync_aggregate"):
             bits = body.sync_aggregate.sync_committee_bits
@@ -156,7 +220,10 @@ class LocalNetwork:
 
     def stop(self) -> None:
         for n in self.nodes:
-            n.network.stop()
+            if not n.dead:
+                n.network.stop()
+            if n.api_server is not None and not n.dead:
+                n.api_server.stop()
 
 
 def main(argv=None) -> int:

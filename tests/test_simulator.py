@@ -53,3 +53,44 @@ def test_two_node_network_finalizes():
         net.stop()
     failures = [r for r in results if not r.ok]
     assert not failures, failures
+
+
+def test_http_sim_with_node_death_fails_over():
+    """fallback_sim.rs equivalent: VCs drive their nodes over REAL HTTP
+    (publication takes POST /eth/v1/beacon/blocks, not an in-process
+    shortcut); killing one BN mid-run leaves the chain finalizing and
+    the dead node's validators proposing through the fallback URL."""
+    from lighthouse_tpu.specs import minimal_spec
+    from lighthouse_tpu.testing.simulator import LocalNetwork
+    spec = minimal_spec(altair_fork_epoch=0)
+    net = LocalNetwork(spec, 2, 64, use_http=True)
+    try:
+        spe = spec.preset.slots_per_epoch
+        net.run_slots(2 * spe)
+        blocks_before = net.nodes[1].vc.published_blocks
+        kill_slot = net.nodes[0].harness.chain.slot()
+        # kill node 1's BN (its VC lives on and fails over to node 0)
+        net.kill_node(1)
+        net.run_slots(2 * spe)
+        results = {r.name: r for r in net.checks(4)}
+        assert results["liveness"].ok, results["liveness"].detail
+        assert results["finalization"].ok, results["finalization"].detail
+        # the dead node's validators kept proposing via the fallback
+        assert net.nodes[1].vc.published_blocks > blocks_before
+        # ...and those post-kill blocks actually LANDED on the surviving
+        # chain (published_blocks alone doesn't prove the POST succeeded)
+        chain0 = net.nodes[0].harness.chain
+        dead_validators = set(range(32, 64))
+        post_kill_landed = 0
+        root = chain0.head().head_block_root
+        while root is not None:
+            blk = chain0.store.get_block(root)
+            if blk is None or blk.message.slot <= kill_slot:
+                break
+            if int(blk.message.proposer_index) in dead_validators:
+                post_kill_landed += 1
+            root = blk.message.parent_root
+        assert post_kill_landed > 0, \
+            "no post-kill block from the dead node's validators landed"
+    finally:
+        net.stop()
