@@ -1,0 +1,150 @@
+"""Backfill sync: download history backwards from a checkpoint anchor.
+
+Equivalent of the reference's backfill machine (network/src/sync/
+backfill_sync/mod.rs): after checkpoint sync the node holds [anchor, head]
+and must recover [genesis, anchor) — batches walk DOWN from the anchor and
+every received block must hash-link into the trusted chain
+(`expected_root`), which subsumes signature verification the way the
+reference's `historical_blocks.rs` chain-linkage does.
+
+Batch downloads pipeline in parallel (fixed descending windows) but are
+*verified* strictly newest-first, because linkage is only checkable against
+the already-verified chain above.  Empty windows are legitimate (runs of
+skipped slots) but an all-empty history down to genesis — which must
+contain the genesis block — or an endless run of empty claims is
+misbehavior: the peer is penalized and the machine stops (the caller
+rotates peers on the next drive).
+"""
+from __future__ import annotations
+
+from .batches import Batch, BatchState
+
+
+class BackfillSync:
+    MAX_EMPTY_WINDOWS = 64
+    BATCH_BUFFER = 4
+
+    def __init__(self, ctx, batch_slots: int | None = None):
+        self.ctx = ctx
+        self.batch_slots = batch_slots or (
+            2 * ctx.slots_per_epoch())
+        self.batches: dict[int, Batch] = {}
+        self.requests: dict[int, int] = {}
+        self.next_batch_id = 0
+        self.process_ptr = 0
+        self.stored = 0
+        self.empty_windows = 0
+        self.stopped = False
+        # [window_low, window_high) spans, high -> low as batch ids grow
+        self._spans: dict[int, tuple[int, int]] = {}
+        self._req_end: int | None = None      # exclusive top of next window
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _anchor(self):
+        return self.ctx.backfill_anchor()
+
+    def drive(self, peers: list[str]) -> None:
+        """Create/dispatch descending windows to the peer pool."""
+        if self.stopped:
+            return
+        anchor = self._anchor()
+        if anchor is None or anchor[0] == 0:
+            return
+        if self._req_end is None:
+            self._req_end = anchor[0]
+        cap = self.ctx.max_request_blocks()
+        window = min(self.batch_slots, cap)
+        while (self._req_end > 0
+               and self.next_batch_id < self.process_ptr + self.BATCH_BUFFER):
+            high = self._req_end
+            low = max(0, high - window)
+            bid = self.next_batch_id
+            self.batches[bid] = Batch(bid, low, high - low)
+            self._spans[bid] = (low, high)
+            self.next_batch_id += 1
+            self._req_end = low
+        for bid in sorted(self.batches):
+            batch = self.batches[bid]
+            if batch.state != BatchState.AWAITING_DOWNLOAD:
+                continue
+            busy = {b.peer for b in self.batches.values()
+                    if b.state == BatchState.DOWNLOADING}
+            pool = [p for p in peers if p not in busy]
+            peer = batch.pick_peer(pool)
+            if peer is None:
+                return
+            req_id = self.ctx.send_range(peer, batch.start_slot, batch.count,
+                                         self)
+            batch.start_download(peer, req_id)
+            self.requests[req_id] = bid
+
+    # -- events --------------------------------------------------------------
+
+    def on_range_response(self, req_id: int, blocks: list | None) -> None:
+        bid = self.requests.pop(req_id, None)
+        if bid is None:
+            return
+        batch = self.batches[bid]
+        if blocks is None:
+            self.ctx.penalize(batch.peer, "timeout")
+            if batch.download_failed() == BatchState.FAILED:
+                self.stopped = True
+            return
+        batch.downloaded(blocks)
+        self._process_ready()
+
+    def _process_ready(self) -> None:
+        """Link-verify batches newest-first into the trusted anchor."""
+        while not self.stopped:
+            batch = self.batches.get(self.process_ptr)
+            if batch is None or batch.state != BatchState.AWAITING_PROCESSING:
+                return
+            blocks = batch.start_processing()
+            anchor = self._anchor()
+            if anchor is None:
+                self.stopped = True
+                return
+            _, expected_root = anchor
+            ok = True
+            stored_here = 0
+            for sb in reversed(blocks):
+                root = self.ctx.block_root(sb)
+                if root != expected_root:
+                    ok = False
+                    break
+                self.ctx.store_backfill_block(root, sb)
+                expected_root = sb.message.parent_root
+                stored_here += 1
+            if not ok:
+                self.ctx.penalize(batch.peer, "bad_segment")
+                if batch.processing_failed() == BatchState.FAILED:
+                    self.stopped = True
+                return
+            if blocks:
+                self.empty_windows = 0
+                self.stored += stored_here
+                new_anchor = blocks[0].message.slot
+                self.ctx.set_backfill_anchor(new_anchor, expected_root)
+                if new_anchor == 0:
+                    self.stopped = True       # reached the genesis block
+                    return
+            else:
+                low, _high = self._spans[batch.id]
+                self.empty_windows += 1
+                if low == 0 or self.empty_windows > self.MAX_EMPTY_WINDOWS:
+                    # an empty [0, x) claims there is no genesis block
+                    self.ctx.penalize(batch.peer, "empty_batch")
+                    self.stopped = True
+                    return
+            batch.processed()
+            self.process_ptr += 1
+
+    @property
+    def in_flight(self) -> int:
+        return len(self.requests)
+
+    @property
+    def complete(self) -> bool:
+        anchor = self._anchor()
+        return anchor is None or anchor[0] == 0

@@ -1,0 +1,273 @@
+"""Range sync: finalized/head syncing chains with per-chain peer pools.
+
+Equivalent of the reference's range sync (network/src/sync/range_sync/
+{range.rs,chain.rs,chain_collection.rs}): peers whose STATUS is ahead of
+the local chain are grouped into *chains* keyed by their claimed target
+(finalized root for finalized sync, head root for head sync).  One chain
+syncs at a time — finalized chains take priority and the best chain is the
+one with the most peers.  Each chain pipelines up to BATCH_BUFFER
+epoch-aligned batches from its pool, imports them strictly in slot order,
+attributes processing failures to the serving peer, retries from other
+peers, and fails the chain (penalizing its pool) after bounded attempts.
+
+The machine is synchronous and network-agnostic: it emits requests through
+a context object (`ctx.send_range(peer, start, count, owner)`) and consumes
+`on_range_response` / `on_download_error` / local processing results — the
+test suite drives it with synthetic events exactly like the reference's
+sync tests (network/src/sync/block_lookups/tests.rs style).
+"""
+from __future__ import annotations
+
+from ...chain.errors import PARENT_UNKNOWN
+from .batches import Batch, BatchState
+
+EPOCHS_PER_BATCH = 2
+
+
+class SyncingChain:
+    BATCH_BUFFER = 5          # in-flight batches beyond the processing head
+
+    def __init__(self, chain_id: int, kind: str, target_root: bytes,
+                 target_slot: int, start_slot: int, batch_slots: int,
+                 ctx=None):
+        assert kind in ("finalized", "head")
+        self.ctx = ctx
+        self.id = chain_id
+        self.kind = kind
+        self.target_root = target_root
+        self.target_slot = target_slot
+        self.start_slot = start_slot          # first slot to download
+        self.batch_slots = batch_slots
+        self.peers: set[str] = set()
+        self.batches: dict[int, Batch] = {}   # batch_id -> Batch
+        self.next_batch_id = 0                # next batch to create
+        self.process_ptr = 0                  # next batch to process in order
+        self.imported = 0
+        self.failed = False
+        self.complete = False
+        # req_id -> batch_id for in-flight downloads
+        self.requests: dict[int, int] = {}
+
+    # -- pool ----------------------------------------------------------------
+
+    def add_peer(self, peer_id: str) -> None:
+        self.peers.add(peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.peers.discard(peer_id)
+
+    @property
+    def available_peers(self) -> list[str]:
+        busy = {b.peer for b in self.batches.values()
+                if b.state == BatchState.DOWNLOADING}
+        return sorted(self.peers - busy)
+
+    # -- batch creation / scheduling ----------------------------------------
+
+    def _batch_start(self, batch_id: int) -> int:
+        return self.start_slot + batch_id * self.batch_slots
+
+    def _total_batches(self) -> int:
+        span = self.target_slot - self.start_slot + 1
+        return max(0, -(-span // self.batch_slots))
+
+    def request_batches(self, ctx=None) -> None:
+        """Create/dispatch downloads up to BATCH_BUFFER beyond the
+        processing pointer, one per available pool peer."""
+        ctx = ctx if ctx is not None else self.ctx
+        if self.failed or self.complete:
+            return
+        total = self._total_batches()
+        # instantiate lazily
+        while (self.next_batch_id < total
+               and self.next_batch_id < self.process_ptr + self.BATCH_BUFFER):
+            bid = self.next_batch_id
+            start = self._batch_start(bid)
+            count = min(self.batch_slots, self.target_slot - start + 1)
+            self.batches[bid] = Batch(bid, start, count)
+            self.next_batch_id += 1
+        for bid in sorted(self.batches):
+            batch = self.batches[bid]
+            if batch.state != BatchState.AWAITING_DOWNLOAD:
+                continue
+            pool = self.available_peers
+            fresh = [p for p in pool if p not in batch.attempted_peers]
+            if fresh:
+                peer = fresh[0]
+            elif self.peers - batch.attempted_peers:
+                continue                    # a fresh peer exists but is busy:
+                                            # defer rather than re-ask a
+                                            # peer that already failed this
+            else:
+                peer = batch.pick_peer(pool)
+                if peer is None:
+                    return                  # no free peers right now
+            req_id = ctx.send_range(peer, batch.start_slot, batch.count, self)
+            batch.start_download(peer, req_id)
+            self.requests[req_id] = bid
+
+    # -- event handlers ------------------------------------------------------
+
+    def on_range_response(self, req_id: int, blocks: list | None,
+                          ctx=None) -> None:
+        """blocks=None means the download failed (error/timeout/decode)."""
+        ctx = ctx if ctx is not None else self.ctx
+        bid = self.requests.pop(req_id, None)
+        if bid is None:
+            return                          # stale response for a dropped req
+        batch = self.batches[bid]
+        if blocks is None:
+            ctx.penalize(batch.peer, "timeout")
+            if batch.download_failed() == BatchState.FAILED:
+                self._fail(ctx)
+                return
+        else:
+            batch.downloaded(blocks)
+        self._process_ready(ctx)
+        self.request_batches(ctx)
+
+    def _process_ready(self, ctx) -> None:
+        """Import batches strictly in order while the frontier is ready."""
+        while not self.failed and not self.complete:
+            batch = self.batches.get(self.process_ptr)
+            if batch is None or batch.state != BatchState.AWAITING_PROCESSING:
+                return
+            blocks = batch.start_processing()
+            imported, err = ctx.process_segment(blocks) if blocks else (0, None)
+            if err is None:
+                self.imported += imported
+                batch.processed()
+                self.process_ptr += 1
+                if self.process_ptr >= self._total_batches():
+                    self._finish(ctx)
+                    return
+            elif err == PARENT_UNKNOWN and self.process_ptr > 0:
+                # the gap is the PREVIOUS batch's fault (a truncated tail
+                # is undetectable at download time): roll back and
+                # re-download batch k-1, don't blame this batch's peer
+                # (range_sync/chain.rs re-downloads the prior batch; the
+                # round-3 sync kept the same attribution)
+                prev = self.batches[self.process_ptr - 1]
+                if prev.peer is not None:
+                    ctx.penalize(prev.peer, "ignore")
+                if prev.processing_attempts >= Batch.MAX_PROCESSING_ATTEMPTS:
+                    self._fail(ctx)
+                    return
+                redo = Batch(prev.id, prev.start_slot, prev.count)
+                redo.processing_attempts = prev.processing_attempts
+                redo.attempted_peers = set(prev.attempted_peers)
+                self.batches[prev.id] = redo
+                batch.state = BatchState.AWAITING_PROCESSING  # retry after
+                self.process_ptr -= 1
+                self.request_batches(ctx)
+                return
+            else:
+                # the serving peer gave us an unusable segment
+                ctx.penalize(batch.peer, "bad_segment")
+                if batch.processing_failed() == BatchState.FAILED:
+                    self._fail(ctx)
+                    return
+                self.request_batches(ctx)
+                return                      # wait for the re-download
+
+    def _finish(self, ctx) -> None:
+        """All batches processed.  An entirely-empty chain whose peers all
+        claimed a higher head is a lie — penalize the pool.  But if the
+        local head advanced past our start while we synced (gossip imports
+        make process_segment return 0 for known blocks), the peers were
+        honest and the work just raced."""
+        self.complete = True
+        if self.imported == 0 and ctx.local_status()[0] < self.start_slot:
+            for p in sorted(self.peers):
+                ctx.penalize(p, "empty_batch")
+
+    def _fail(self, ctx) -> None:
+        self.failed = True
+        for p in sorted(self.peers):
+            ctx.penalize(p, "ignore")
+
+    @property
+    def in_flight(self) -> int:
+        return len(self.requests)
+
+
+class RangeSync:
+    """Chain collection: groups STATUS-ahead peers into chains, syncs the
+    best one (finalized > head, then most peers), drops completed/failed
+    chains (chain_collection.rs behavior)."""
+
+    def __init__(self, ctx, batch_slots: int | None = None):
+        self.ctx = ctx
+        self.chains: dict[tuple, SyncingChain] = {}
+        self.retired: set[tuple] = set()   # completed/failed targets
+        self._next_chain_id = 0
+        self.batch_slots = batch_slots or (
+            EPOCHS_PER_BATCH * ctx.slots_per_epoch())
+
+    # -- peer intake ---------------------------------------------------------
+
+    def add_peer(self, peer_id: str, status) -> None:
+        """Classify the peer by its STATUS against our local view: a
+        finalized-ahead peer joins a finalized chain; once that target is
+        retired (synced or proven bad) a still-head-ahead peer falls
+        through to a head chain (our own finality may lag the imported
+        blocks' epoch processing)."""
+        local_head, local_fin_epoch = self.ctx.local_status()
+        spe = self.ctx.slots_per_epoch()
+        candidates = []
+        if status.finalized_epoch > local_fin_epoch:
+            candidates.append(("finalized", status.finalized_root,
+                               status.finalized_epoch * spe))
+        if status.head_slot > local_head:
+            candidates.append(("head", status.head_root, status.head_slot))
+        for key in candidates:
+            if key in self.retired or key[2] <= local_head:
+                continue
+            chain = self.chains.get(key)
+            if chain is None:
+                chain = SyncingChain(
+                    self._next_chain_id, key[0], key[1], key[2],
+                    start_slot=local_head + 1,
+                    batch_slots=self.batch_slots, ctx=self.ctx)
+                self._next_chain_id += 1
+                self.chains[key] = chain
+            chain.add_peer(peer_id)
+            return
+
+    def remove_peer(self, peer_id: str) -> None:
+        for chain in self.chains.values():
+            chain.remove_peer(peer_id)
+
+    # -- scheduling ----------------------------------------------------------
+
+    def best_chain(self) -> SyncingChain | None:
+        """Finalized chains beat head chains; more peers beats fewer —
+        purging dead chains first (their targets are retired so a stale
+        STATUS can't resurrect them)."""
+        self.retired |= {k for k, c in self.chains.items()
+                         if c.failed or c.complete}
+        self.chains = {k: c for k, c in self.chains.items()
+                       if not c.failed and not c.complete and c.peers}
+        ranked = sorted(
+            self.chains.values(),
+            key=lambda c: (c.kind != "finalized", -len(c.peers), c.id))
+        return ranked[0] if ranked else None
+
+    def drive(self) -> SyncingChain | None:
+        """Dispatch requests on the currently-best chain."""
+        chain = self.best_chain()
+        if chain is not None:
+            chain.request_batches(self.ctx)
+        return chain
+
+    def on_range_response(self, req_id: int, blocks: list | None) -> None:
+        for chain in list(self.chains.values()):
+            if req_id in chain.requests:
+                chain.on_range_response(req_id, blocks, self.ctx)
+                return
+
+    @property
+    def syncing(self) -> bool:
+        return any(c.in_flight or (not c.complete and not c.failed
+                                   and c.peers)
+                   for c in self.chains.values())
