@@ -252,6 +252,12 @@ class ApiBackend:
 
     def attestation_data(self, slot: int, committee_index: int):
         chain = self.chain
+        # fast path: the early-attester cache serves the current head
+        # state-free (early_attester_cache.rs:1-30)
+        early = chain.early_attester_cache.try_attest(chain, slot,
+                                                      committee_index)
+        if early is not None:
+            return early
         head = chain.head()
         st = head.head_state
         if st.slot < slot:

@@ -65,7 +65,9 @@ class BeaconProcessor:
     def __init__(self, num_workers: int = 4,
                  batch_handler: Callable | None = None,
                  aggregate_batch_handler: Callable | None = None):
+        from .reprocess import ReprocessQueue
         self.queues: dict[WorkType, deque] = {w: deque() for w in WorkType}
+        self.reprocess = ReprocessQueue(self.submit)
         self.caps = dict(DEFAULT_CAPS)
         self.batch_handler = batch_handler
         self.aggregate_batch_handler = aggregate_batch_handler
@@ -131,13 +133,32 @@ class BeaconProcessor:
                            if kind == WorkType.GOSSIP_ATTESTATION
                            else self.aggregate_batch_handler)
                 if handler is not None:
-                    handler([w.batchable_payload for w in work])
+                    payloads = [w.batchable_payload for w in work
+                                if w.batchable_payload is not None]
+                    if payloads:
+                        handler(payloads)
+                    # replayed (parked) items carry no payload — they
+                    # re-run their full verification closure
+                    for w in work:
+                        if w.batchable_payload is None:
+                            w.run()
                 else:
                     for w in work:
                         w.run()
                 self.processed += len(work)
             else:
-                work.run()
+                handler = (self.batch_handler
+                           if work.kind == WorkType.GOSSIP_ATTESTATION
+                           else self.aggregate_batch_handler
+                           if work.kind == WorkType.GOSSIP_AGGREGATE
+                           else None)
+                if handler is not None and work.batchable_payload is not None:
+                    # a lone gossip item is a batch of one — its run() is
+                    # a no-op placeholder and the payload must still reach
+                    # the handler
+                    handler([work.batchable_payload])
+                else:
+                    work.run()
                 self.processed += 1
         except Exception:
             import logging

@@ -1,9 +1,18 @@
 """Park-and-replay queue for early/unresolvable work.
 
-Equivalent of beacon_processor/src/work_reprocessing_queue.rs: early-arriving
-gossip (future-slot attestations/blocks) and attestations for unknown blocks
-are parked and re-enqueued when their slot arrives or their block is
-imported.
+Equivalent of beacon_processor/src/work_reprocessing_queue.rs (:1-60):
+- early-arriving gossip blocks are parked until their slot starts and
+  re-enter the processor's priority queues at the boundary;
+- attestations/aggregates referencing an unknown block root are parked
+  and replayed the moment that block imports (the reference replays via
+  the `BlockImported` reprocess event);
+- future-slot attestations are parked until their slot;
+- buckets are bounded, and unresolved by-root parks expire after
+  EXPIRY_SLOTS so a junk root can't pin memory forever.
+
+The queue holds `Work` items and re-enters them through the submitter
+(BeaconProcessor.submit), so replayed work flows through the same
+priority scheduling as fresh gossip.
 """
 from __future__ import annotations
 
@@ -12,37 +21,72 @@ from collections import defaultdict
 
 
 class ReprocessQueue:
+    EXPIRY_SLOTS = 64          # by-root parks older than this are dropped
+    MAX_FUTURE_SLOTS = 64      # refuse parks this far past the clock
+
     def __init__(self, submit):
         self._submit = submit                 # BeaconProcessor.submit
         self._by_slot: dict[int, list] = defaultdict(list)
-        self._by_root: dict[bytes, list] = defaultdict(list)
+        # root -> (parked_at_slot, [work, ...])
+        self._by_root: dict[bytes, tuple[int, list]] = {}
         self._lock = threading.Lock()
         self.max_per_bucket = 1024
+        self.parked_total = 0
+        self.replayed_total = 0
+        self.expired_total = 0
+        self.refused_total = 0
 
-    def park_until_slot(self, slot: int, work) -> None:
+    def park_until_slot(self, slot: int, work,
+                        current_slot: int | None = None) -> None:
+        """Parks are clock-bounded: future_slot is raised BEFORE any
+        signature check, so attacker-chosen far-future slots must not pin
+        memory (each distinct slot would otherwise open a fresh bucket)."""
+        if current_slot is not None and \
+                slot > current_slot + self.MAX_FUTURE_SLOTS:
+            self.refused_total += 1
+            return
         with self._lock:
             bucket = self._by_slot[slot]
             if len(bucket) < self.max_per_bucket:
                 bucket.append(work)
+                self.parked_total += 1
 
-    def park_until_block(self, block_root: bytes, work) -> None:
+    def park_until_block(self, block_root: bytes, work,
+                         current_slot: int = 0) -> None:
         with self._lock:
-            bucket = self._by_root[block_root]
+            parked_at, bucket = self._by_root.get(block_root,
+                                                  (current_slot, []))
             if len(bucket) < self.max_per_bucket:
                 bucket.append(work)
+                self.parked_total += 1
+            self._by_root[block_root] = (parked_at, bucket)
 
     def on_slot(self, slot: int) -> int:
-        """Replay everything parked for slots <= slot."""
+        """Replay everything parked for slots <= slot; expire stale
+        by-root parks (their block never arrived)."""
         with self._lock:
             due = [w for s in list(self._by_slot)
                    if s <= slot for w in self._by_slot.pop(s)]
+            for root in list(self._by_root):
+                parked_at, bucket = self._by_root[root]
+                if parked_at + self.EXPIRY_SLOTS < slot:
+                    self._by_root.pop(root)
+                    self.expired_total += len(bucket)
         for w in due:
             self._submit(w)
+        self.replayed_total += len(due)
         return len(due)
 
     def on_block_imported(self, block_root: bytes) -> int:
         with self._lock:
-            due = self._by_root.pop(block_root, [])
+            _at, due = self._by_root.pop(block_root, (0, []))
         for w in due:
             self._submit(w)
+        self.replayed_total += len(due)
         return len(due)
+
+    @property
+    def parked(self) -> int:
+        with self._lock:
+            return (sum(len(b) for b in self._by_slot.values())
+                    + sum(len(b) for _a, b in self._by_root.values()))

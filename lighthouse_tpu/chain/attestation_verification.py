@@ -13,19 +13,17 @@ import hashlib
 from dataclasses import dataclass
 
 from ..crypto import bls
-from ..specs.chain_spec import compute_signing_root
+from ..specs.chain_spec import ForkName, compute_domain, compute_signing_root
 from ..specs.constants import (
-    DOMAIN_AGGREGATE_AND_PROOF, DOMAIN_SELECTION_PROOF,
-    TARGET_AGGREGATORS_PER_COMMITTEE,
+    DOMAIN_AGGREGATE_AND_PROOF, DOMAIN_BEACON_ATTESTER,
+    DOMAIN_SELECTION_PROOF, TARGET_AGGREGATORS_PER_COMMITTEE,
 )
 from ..ssz import htr, uint64, hash_tree_root
 from ..state_transition.helpers import (
-    committee_cache, compute_epoch_at_slot, get_beacon_committee, get_domain,
-    get_indexed_attestation,
+    attesting_indices_from_committees, compute_epoch_at_slot,
+    get_beacon_committee, get_domain,
 )
-from ..state_transition.signature_sets import (
-    indexed_attestation_signature_set,
-)
+from ..state_transition.signature_sets import SignatureSetError, _pubkey
 from .errors import (
     BAD_SIGNATURE, BAD_TARGET, EMPTY_AGGREGATION_BITS, NOT_AGGREGATOR,
     PAST_SLOT, PRIOR_SEEN, UNKNOWN_HEAD_BLOCK, AttestationError,
@@ -75,6 +73,74 @@ def _attestation_state(chain, attestation):
     return chain.state_for_attestation(attestation.data)
 
 
+def _attestation_context(chain, attestation):
+    """(committee_at, base_state) for verification WITHOUT a state replay:
+    committees come from the chain-level ShufflingCache (shuffling_cache.rs
+    promise — one replay per shuffling decision root, then dict hits) and
+    pubkeys from the head state's registry (append-only; domains are
+    spec-schedule-derived, so any base state works).  Falls back to the
+    replay path only if a registry index is out of range (a fork with
+    deposits our head hasn't processed)."""
+    cc = chain.shuffling_cache.get_or_build(chain, attestation.data)
+
+    def committee_at(slot, index):
+        if index >= cc.committees_per_slot:
+            raise AttestationError(BAD_TARGET,
+                                   f"committee index {index} out of range")
+        return cc.committee(slot, index)
+
+    return committee_at, chain.head().head_state
+
+
+def _indexed_via_cache(chain, committee_at, base_state, attestation):
+    data = attestation.data
+    electra = chain.spec.fork_name_at_slot(data.slot) >= ForkName.ELECTRA
+    indices = [int(i) for i in attesting_indices_from_committees(
+        committee_at, attestation, electra)]
+    T = base_state.T
+    cls = T.IndexedAttestationElectra if electra else T.IndexedAttestation
+    return cls(attesting_indices=indices, data=data,
+               signature=attestation.signature)
+
+
+def _domain_at_epoch(chain, base_state, domain_type: int,
+                     epoch: int) -> bytes:
+    version = chain.spec.fork_version(chain.spec.fork_name_at_epoch(epoch))
+    return compute_domain(domain_type, version,
+                          base_state.genesis_validators_root)
+
+
+def _verification_providers(chain, attestation):
+    """Yield (committee_at, pubkey_fn, domain_fn) provider triples: first
+    the cache-backed fast set (no state replay), then — only if the fast
+    set raises IndexError/SignatureSetError, i.e. the head registry lags
+    the attestation's chain — the state-replay set.  One shared checks
+    body runs against whichever set works, so the fast path and the
+    fallback can never diverge."""
+    committee_at, base = _attestation_context(chain, attestation)
+    yield (committee_at,
+           lambda i: _pubkey(base, i),
+           lambda dt, ep: _domain_at_epoch(chain, base, dt, ep),
+           base)
+    state = _attestation_state(chain, attestation)
+    yield (lambda s, i: get_beacon_committee(state, s, i),
+           lambda i: _pubkey(state, i),
+           lambda dt, ep: get_domain(state, dt, ep),
+           state)
+
+
+def _indexed_and_set(chain, attestation, committee_at, pubkey_fn,
+                     domain_fn, base_state):
+    indexed = _indexed_via_cache(chain, committee_at, base_state,
+                                 attestation)
+    if not indexed.attesting_indices:
+        raise AttestationError(EMPTY_AGGREGATION_BITS, "no attester")
+    domain = domain_fn(DOMAIN_BEACON_ATTESTER, indexed.data.target.epoch)
+    signing_root = compute_signing_root(htr(indexed.data), domain)
+    pks = [pubkey_fn(i) for i in indexed.attesting_indices]
+    return indexed, bls.SignatureSet(indexed.signature, pks, signing_root)
+
+
 def verify_unaggregated_checks(chain, attestation,
                                subnet_id: int | None = None):
     """All checks except the signature; returns (indexed, state, set)."""
@@ -82,16 +148,20 @@ def verify_unaggregated_checks(chain, attestation,
     if sum(1 for b in attestation.aggregation_bits if b) != 1:
         raise AttestationError(EMPTY_AGGREGATION_BITS,
                                "unaggregated must have exactly one bit")
-    state = _attestation_state(chain, attestation)
-    indexed = get_indexed_attestation(state, attestation)
-    if not indexed.attesting_indices:
-        raise AttestationError(EMPTY_AGGREGATION_BITS, "no attester")
+    providers = _verification_providers(chain, attestation)
+    try:
+        committee_at, pubkey_fn, domain_fn, base = next(providers)
+        indexed, s = _indexed_and_set(chain, attestation, committee_at,
+                                      pubkey_fn, domain_fn, base)
+    except (IndexError, SignatureSetError):
+        committee_at, pubkey_fn, domain_fn, base = next(providers)
+        indexed, s = _indexed_and_set(chain, attestation, committee_at,
+                                      pubkey_fn, domain_fn, base)
     validator = indexed.attesting_indices[0]
     if chain.observed_attesters.has_been_observed(
             attestation.data.target.epoch, validator):
         raise AttestationError(PRIOR_SEEN, f"validator {validator}")
-    s = indexed_attestation_signature_set(state, indexed)
-    return indexed, state, s
+    return indexed, base, s
 
 
 def finalize_unaggregated(chain, attestation, indexed,
@@ -165,7 +235,6 @@ def verify_aggregated_checks(chain, signed_aggregate):
     aggregate = msg.aggregate
     _common_checks(chain, aggregate)
     data = aggregate.data
-    state = _attestation_state(chain, aggregate)
     if chain.observed_aggregators.has_been_observed(
             data.slot, msg.aggregator_index):
         raise AttestationError(PRIOR_SEEN,
@@ -173,28 +242,35 @@ def verify_aggregated_checks(chain, signed_aggregate):
     if chain.observed_aggregates.is_known_subset(
             data.slot, htr(data), tuple(aggregate.aggregation_bits)):
         raise AttestationError(PRIOR_SEEN, "aggregate subset known")
-    committee = get_beacon_committee(state, data.slot, data.index)
-    if not is_aggregator(len(committee), msg.selection_proof):
-        raise AttestationError(NOT_AGGREGATOR, "")
-    if msg.aggregator_index not in [int(i) for i in committee]:
-        raise AttestationError(NOT_AGGREGATOR, "not in committee")
-    indexed = get_indexed_attestation(state, aggregate)
-    if not indexed.attesting_indices:
-        raise AttestationError(EMPTY_AGGREGATION_BITS, "")
 
-    # three signature sets per aggregate (batch.rs:60-103)
-    epoch = compute_epoch_at_slot(data.slot, chain.spec.preset.slots_per_epoch)
-    agg_pk = state.validators.pubkey(msg.aggregator_index)
-    sel_domain = get_domain(state, DOMAIN_SELECTION_PROOF, epoch)
-    sel_root = compute_signing_root(
-        hash_tree_root(uint64, data.slot), sel_domain)
-    set_selection = bls.SignatureSet(msg.selection_proof, [agg_pk], sel_root)
-    agg_domain = get_domain(state, DOMAIN_AGGREGATE_AND_PROOF, epoch)
-    agg_root = compute_signing_root(htr(msg), agg_domain)
-    set_aggregator = bls.SignatureSet(signed_aggregate.signature, [agg_pk],
-                                      agg_root)
-    set_attestation = indexed_attestation_signature_set(state, indexed)
-    return indexed, [set_selection, set_aggregator, set_attestation]
+    def body(committee_at, pubkey_fn, domain_fn, base):
+        committee = committee_at(data.slot, data.index)
+        if not is_aggregator(len(committee), msg.selection_proof):
+            raise AttestationError(NOT_AGGREGATOR, "")
+        if msg.aggregator_index not in [int(i) for i in committee]:
+            raise AttestationError(NOT_AGGREGATOR, "not in committee")
+        indexed, set_attestation = _indexed_and_set(
+            chain, aggregate, committee_at, pubkey_fn, domain_fn, base)
+        # three signature sets per aggregate (batch.rs:60-103)
+        epoch = compute_epoch_at_slot(data.slot,
+                                      chain.spec.preset.slots_per_epoch)
+        agg_pk = pubkey_fn(msg.aggregator_index)
+        sel_root = compute_signing_root(
+            hash_tree_root(uint64, data.slot),
+            domain_fn(DOMAIN_SELECTION_PROOF, epoch))
+        set_selection = bls.SignatureSet(msg.selection_proof, [agg_pk],
+                                         sel_root)
+        agg_root = compute_signing_root(
+            htr(msg), domain_fn(DOMAIN_AGGREGATE_AND_PROOF, epoch))
+        set_aggregator = bls.SignatureSet(signed_aggregate.signature,
+                                          [agg_pk], agg_root)
+        return indexed, [set_selection, set_aggregator, set_attestation]
+
+    providers = _verification_providers(chain, aggregate)
+    try:
+        return body(*next(providers))
+    except (IndexError, SignatureSetError):
+        return body(*next(providers))
 
 
 def finalize_aggregated(chain, signed_aggregate,

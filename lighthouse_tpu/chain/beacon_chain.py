@@ -109,9 +109,21 @@ class BeaconChain:
         self.canonical_head = CanonicalHead(
             self.genesis_block_root, genesis_block, genesis_state)
 
-        # caches (the reference's ~15 specialized caches, folded)
+        # caches (the reference's ~15 specialized caches)
         self._snapshots: OrderedDict[bytes, BeaconState] = OrderedDict()
         self._snapshots[self.genesis_block_root] = genesis_state
+        from .hot_caches import (
+            EarlyAttesterCache, PreFinalizationCache, ProposerCache,
+            ShufflingCache,
+        )
+        self.shuffling_cache = ShufflingCache()
+        self.proposer_cache = ProposerCache()
+        self.early_attester_cache = EarlyAttesterCache()
+        self.pre_finalization_cache = PreFinalizationCache()
+        self._advanced: tuple[bytes, BeaconState] | None = None
+        # set by the network service when a BeaconProcessor is attached;
+        # drives the park-and-replay queue (work_reprocessing_queue.rs)
+        self.processor = None
 
         self.observed_block_producers = ObservedBlockProducers()
         self.observed_attesters = ObservedAttesters()
@@ -225,8 +237,16 @@ class BeaconChain:
     def state_for_block_production(self, parent_root: bytes,
                                    slot: int) -> BeaconState:
         """Parent state advanced to `slot` (cheap_state_advance analog —
-        committees/proposers only need the slot advance)."""
-        st = self._state_for(parent_root)
+        committees/proposers only need the slot advance).  Prefers the
+        state-advance timer's pre-computed epoch crossing
+        (state_advance_timer.rs:1-15) so the first block of an epoch
+        doesn't pay epoch processing inline."""
+        st = None
+        adv = self._advanced
+        if adv is not None and adv[0] == parent_root and adv[1].slot <= slot:
+            st = adv[1]
+        if st is None:
+            st = self._state_for(parent_root)
         if st is None:
             raise BlockError(PARENT_UNKNOWN, parent_root.hex())
         st = st.copy()
@@ -439,8 +459,17 @@ class BeaconChain:
             self.store.put_block(block_root, ep.signed_block)
             self.store.put_state(block.state_root, state)
             self._cache_snapshot(block_root, state)
+            try:
+                # serve attestations for this block state-free from now on
+                # (early_attester_cache.rs:1-30)
+                self.early_attester_cache.add(self, block_root, block, state)
+            except Exception:               # pragma: no cover - advisory
+                pass
         self.events.emit("block", {"slot": block.slot,
                                    "block_root": block_root})
+        if self.processor is not None:
+            # wake attestations parked on this root
+            self.processor.reprocess.on_block_imported(block_root)
         if self.config.enable_light_client_server:
             try:
                 self.light_client_cache.on_head_update(ep.signed_block, state)
@@ -658,6 +687,17 @@ class BeaconChain:
         slot = self.slot()
         with self._lock:
             self.fork_choice.update_time(slot)
+        from .hot_caches import state_advance
+        try:
+            state_advance(self, slot)
+        except Exception:                   # pragma: no cover - advisory
+            import logging
+            logging.getLogger("lighthouse_tpu.chain").exception(
+                "state-advance timer failed")
+        if self.processor is not None:
+            # replay gossip parked for this slot (early blocks /
+            # future-slot attestations, work_reprocessing_queue.rs)
+            self.processor.reprocess.on_slot(slot)
 
     # -- attestation entry points -------------------------------------------
 

@@ -85,19 +85,43 @@ def verify_block_for_gossip(chain, signed_block) -> GossipVerifiedBlock:
                          f"proposer {block.proposer_index} equivocated")
 
     if not chain.fork_choice.contains_block(block.parent_root):
+        if chain.pre_finalization_cache.contains(block.parent_root):
+            # parent already proven pre-finalization garbage — reject
+            # without re-triggering a lookup (pre_finalization_cache.rs)
+            raise BlockError(FINALIZED_SLOT,
+                             f"parent {block.parent_root.hex()} "
+                             "pre-finalization")
         raise BlockError(PARENT_UNKNOWN, block.parent_root.hex())
 
-    # proposer shuffling via cheap state advance of the parent state
-    # (beacon_chain.rs:2062)
-    state = chain.state_for_block_production(block.parent_root, block.slot)
-    expected_proposer = get_beacon_proposer_index(state, block.slot)
+    # proposer via the epoch-wide proposer cache (one state advance per
+    # shuffling decision root, then dict hits — beacon_proposer_cache.rs;
+    # the r3 code replayed the parent state per block, beacon_chain.rs:2062)
+    expected_proposer = chain.proposer_cache.proposer_at(
+        chain, block.parent_root, block.slot)
     if block.proposer_index != expected_proposer:
         raise BlockError(INCORRECT_PROPOSER,
                          f"got {block.proposer_index}, "
                          f"expected {expected_proposer}")
 
-    # proposer signature (beacon_chain.rs:2140)
-    s = block_proposal_signature_set(state, signed_block, block_root)
+    # proposer signature (beacon_chain.rs:2140): pubkey from the head
+    # registry (append-only), domain from the spec fork schedule — no
+    # state replay on this path either
+    head_state = chain.head().head_state
+    try:
+        from ..specs.chain_spec import compute_domain
+        from ..specs.constants import DOMAIN_BEACON_PROPOSER
+        version = chain.spec.fork_version(
+            chain.spec.fork_name_at_slot(block.slot))
+        domain = compute_domain(DOMAIN_BEACON_PROPOSER, version,
+                                head_state.genesis_validators_root)
+        from ..specs.chain_spec import compute_signing_root
+        signing_root = compute_signing_root(block_root, domain)
+        pk = head_state.validators.pubkey(block.proposer_index)
+        s = bls.SignatureSet(signed_block.signature, [pk], signing_root)
+    except IndexError:
+        state = chain.state_for_block_production(block.parent_root,
+                                                 block.slot)
+        s = block_proposal_signature_set(state, signed_block, block_root)
     if not bls.verify_signature_sets([s]):
         raise BlockError(INVALID_SIGNATURE, "proposer signature")
 

@@ -192,6 +192,23 @@ class ProtoArray:
             i = self.nodes[i].parent
         return i == fin_i
 
+    def ancestor_at_or_below_slot(self, root: bytes,
+                                  slot: int) -> bytes | None:
+        """Root of the ancestor of `root` with the highest slot <= `slot`
+        (the *shuffling decision root* walk, shuffling_cache.rs keying).
+        When the chain below is pruned, the oldest retained ancestor (the
+        finalized root) is returned — everything beneath it is shared, so
+        it still uniquely keys the shuffling.  None for unknown `root`."""
+        i = self.indices.get(root)
+        if i is None:
+            return None
+        while self.nodes[i].slot > slot:
+            parent = self.nodes[i].parent
+            if parent is None:
+                break
+            i = parent
+        return self.nodes[i].root
+
     def is_descendant(self, ancestor_root: bytes,
                       descendant_root: bytes) -> bool:
         a = self.indices.get(ancestor_root)

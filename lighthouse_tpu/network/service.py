@@ -40,6 +40,9 @@ class NetworkService:
         if processor is not None:
             processor.batch_handler = self._attestation_batch
             processor.start()
+            # chain hooks drive the park-and-replay queue (slot ticks +
+            # block imports, work_reprocessing_queue.rs)
+            chain.processor = processor
         self.transport = Transport(self.config.host, self.config.port)
         digest = compute_fork_digest(
             chain.head().head_state.fork.current_version,
@@ -257,16 +260,29 @@ class NetworkService:
                 fork = chain.spec.fork_name_at_slot(max(chain.slot(), 0))
                 signed = deserialize(
                     chain.T.SignedBeaconBlock[fork].ssz_type, data)
-                chain.verify_block_for_gossip(signed)
+                try:
+                    chain.verify_block_for_gossip(signed)
+                except BlockError as e:
+                    if e.kind == "future_slot":
+                        self._park_early_block(signed)
+                    raise
                 return "accept", signed
             if topic.startswith("beacon_attestation_"):
                 att = deserialize(chain.T.Attestation.ssz_type, data)
-                v = chain.verify_unaggregated_attestation_for_gossip(att)
+                try:
+                    v = chain.verify_unaggregated_attestation_for_gossip(att)
+                except AttestationError as e:
+                    self._maybe_park_attestation(att, e, aggregated=False)
+                    raise
                 return "accept", v
             if topic == Topic.AGGREGATE:
                 agg = deserialize(
                     chain.T.SignedAggregateAndProof.ssz_type, data)
-                v = chain.verify_aggregated_attestation_for_gossip(agg)
+                try:
+                    v = chain.verify_aggregated_attestation_for_gossip(agg)
+                except AttestationError as e:
+                    self._maybe_park_attestation(agg, e, aggregated=True)
+                    raise
                 return "accept", v
             if topic.startswith("data_column_sidecar_"):
                 sc = deserialize(chain.T.DataColumnSidecar.ssz_type, data)
@@ -293,6 +309,71 @@ class NetworkService:
                 None
         except Exception:
             return "reject", None
+
+    # -- park-and-replay (work_reprocessing_queue.rs) ------------------------
+
+    def _park_early_block(self, signed) -> None:
+        """Early-arriving gossip block: park until its slot starts, then
+        re-enter the processor as GOSSIP_BLOCK work (early-block parking,
+        work_reprocessing_queue.rs:1-60)."""
+        if self.processor is None:
+            return
+        from ..beacon_processor import Work, WorkType
+        self.processor.reprocess.park_until_slot(
+            signed.message.slot,
+            Work(WorkType.GOSSIP_BLOCK,
+                 lambda: self._replay_block(signed)),
+            current_slot=self.chain.slot())
+
+    def _replay_block(self, signed) -> None:
+        """Replayed early block goes through the SAME pipeline as fresh
+        gossip: gossip verification first (equivocation/observed-proposer
+        bookkeeping), then import with an unknown-parent lookup fallback."""
+        try:
+            self.chain.verify_block_for_gossip(signed)
+        except BlockError:
+            return
+        try:
+            self.chain.process_block(signed, proposal_already_verified=True)
+        except BlockError as e:
+            if e.kind == "parent_unknown":
+                best = self.peers.best_peer_for_sync()
+                if best is not None:
+                    self.sync.lookup_unknown_parent(htr(signed.message),
+                                                    best.node_id)
+
+    def _maybe_park_attestation(self, att_or_agg, err, aggregated) -> None:
+        """Unknown-root attestations wait for their block; future-slot
+        attestations wait for their slot (unknown-root replay,
+        work_reprocessing_queue.rs:1-60)."""
+        if self.processor is None:
+            return
+        from ..beacon_processor import Work, WorkType
+        data = (att_or_agg.message.aggregate.data if aggregated
+                else att_or_agg.data)
+        kind = (WorkType.GOSSIP_AGGREGATE if aggregated
+                else WorkType.GOSSIP_ATTESTATION)
+        work = Work(kind, lambda: self._replay_attestation(att_or_agg,
+                                                           aggregated))
+        if err.kind == "unknown_head_block":
+            self.processor.reprocess.park_until_block(
+                bytes(data.beacon_block_root), work,
+                current_slot=self.chain.slot())
+        elif err.kind == "future_slot":
+            self.processor.reprocess.park_until_slot(
+                data.slot, work, current_slot=self.chain.slot())
+
+    def _replay_attestation(self, att_or_agg, aggregated) -> None:
+        try:
+            if aggregated:
+                v = self.chain.verify_aggregated_attestation_for_gossip(
+                    att_or_agg)
+            else:
+                v = self.chain.verify_unaggregated_attestation_for_gossip(
+                    att_or_agg)
+            self._apply_verified(v)
+        except AttestationError:
+            pass
 
     def _deliver_gossip(self, topic: str, data: bytes, peer, ctx) -> None:
         """Route accepted gossip into the priority processor when present

@@ -103,6 +103,14 @@ class BlockLookups:
             self.ctx.penalize(peer_id, "bad_segment")
             self._request(lk)
             return
+        if block.message.slot <= self.ctx.finalized_slot():
+            # an unknown block at/below the finalized slot can never join
+            # the canonical chain: remember the root so gossip referencing
+            # it is rejected instantly (pre_finalization_cache.rs)
+            self.ctx.note_pre_finalization(lk.awaiting)
+            self.ctx.penalize(peer_id, "ignore")
+            self.lookups.pop(lk.id, None)
+            return
         lk.chain.append((lk.awaiting, block))
         parent = block.message.parent_root
         if self.ctx.block_known(parent):

@@ -75,6 +75,13 @@ class _RealSyncContext:
     def penalize(self, peer_id: str, reason: str) -> None:
         self.peers.report(peer_id, reason)
 
+    def finalized_slot(self) -> int:
+        fin_epoch = int(self.chain.fork_choice.finalized_checkpoint[0])
+        return fin_epoch * self.slots_per_epoch()
+
+    def note_pre_finalization(self, root: bytes) -> None:
+        self.chain.pre_finalization_cache.insert(root)
+
     def on_lookup_imported(self, root: bytes) -> None:
         proc = getattr(self.chain, "processor", None)
         if proc is not None and getattr(proc, "reprocess", None) is not None:

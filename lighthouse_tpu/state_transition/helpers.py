@@ -240,29 +240,38 @@ def get_beacon_proposer_index(state: BeaconState, slot: int | None = None
 
 # -- attestations ------------------------------------------------------------
 
-def get_attesting_indices(state: BeaconState, attestation) -> np.ndarray:
-    """Sorted unique indices that attested (fork-aware: electra committee_bits)."""
+def attesting_indices_from_committees(committee_at, attestation,
+                                      electra: bool) -> np.ndarray:
+    """Sorted unique attesting indices, parameterized over the committee
+    source (`committee_at(slot, index) -> np.ndarray`) so the chain-level
+    ShufflingCache can serve lookups without a state replay."""
     data = attestation.data
-    if state.fork_name >= ForkName.ELECTRA and hasattr(attestation,
-                                                       "committee_bits"):
+    if electra and hasattr(attestation, "committee_bits"):
         out = []
         offset = 0
         bits = attestation.aggregation_bits
         for committee_index, present in enumerate(attestation.committee_bits):
             if not present:
                 continue
-            committee = get_beacon_committee(state, data.slot, committee_index)
+            committee = committee_at(data.slot, committee_index)
             sel = [committee[i] for i in range(len(committee))
                    if offset + i < len(bits) and bits[offset + i]]
             out.extend(int(x) for x in sel)
             offset += len(committee)
         return np.asarray(sorted(set(out)), dtype=np.int64)
-    committee = get_beacon_committee(state, data.slot, data.index)
+    committee = committee_at(data.slot, data.index)
     bits = attestation.aggregation_bits
     if len(bits) != len(committee):
         raise StateError("aggregation bits length != committee size")
     mask = np.asarray(bits, dtype=bool)
     return np.sort(committee[mask])
+
+
+def get_attesting_indices(state: BeaconState, attestation) -> np.ndarray:
+    """Sorted unique indices that attested (fork-aware: electra committee_bits)."""
+    return attesting_indices_from_committees(
+        lambda s, i: get_beacon_committee(state, s, i), attestation,
+        state.fork_name >= ForkName.ELECTRA)
 
 
 def get_indexed_attestation(state: BeaconState, attestation):

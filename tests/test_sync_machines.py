@@ -69,6 +69,7 @@ class FakeCtx:
         self.anchor = None            # backfill anchor
         self.stored = []              # backfill stored blocks
         self.lookup_imports = []
+        self.pre_finalized = []       # roots noted pre-finalization
         self._next = 0
 
     # chain views
@@ -98,6 +99,12 @@ class FakeCtx:
 
     def on_lookup_imported(self, root):
         self.lookup_imports.append(root)
+
+    def finalized_slot(self):
+        return self.fin_epoch * self.spe
+
+    def note_pre_finalization(self, root):
+        self.pre_finalized.append(root)
 
     # backfill hooks
     def backfill_anchor(self):
@@ -507,6 +514,22 @@ def test_lookup_known_root_is_noop():
     lk = BlockLookups(ctx)
     lk.search(b"known".ljust(32, b"\0"), "p1")
     assert ctx.root_reqs == []
+
+
+def test_lookup_pre_finalization_block_noted_and_dropped():
+    """An unknown block at/below the finalized slot can never become
+    canonical: the lookup dies and the root is remembered
+    (pre_finalization_cache.rs)."""
+    ctx = FakeCtx(spe=8, fin_epoch=2)          # finalized slot 16
+    lk = BlockLookups(ctx)
+    root = b"old".ljust(32, b"\0")
+    lk.search(root, "p1")
+    rid, peer, _ = ctx.root_reqs[0]
+    old_block = FakeBlock(root, FakeBlockMsg(10, b"x" * 32))
+    lk.on_root_response(rid, old_block, peer)
+    assert lk.lookups == {}
+    assert ctx.pre_finalized == [root]
+    assert ctx.processed == []
 
 
 def test_lookup_concurrency_cap():
