@@ -197,12 +197,122 @@ class ApiBackend:
         _root, blk = self._resolve_block(block_id)
         return serialize(type(blk).ssz_type, blk)
 
-    def publish_block(self, signed_block) -> None:
+    def _block_meta(self, blk, root: bytes | None = None
+                    ) -> tuple[str, bool]:
+        """(consensus version string, finalized?) for response envelopes
+        (the fork-versioned headers/fields of the v2 endpoints).
+        Finalized = at/below the finalized slot AND canonical — a stored
+        fork block below finality is NOT finalized."""
+        version = type(blk).fork_name.name.lower()
+        fin_epoch = int(self.chain.finalized_checkpoint()[0])
+        spe = self.chain.spec.preset.slots_per_epoch
+        slot = blk.message.slot
+        finalized = slot <= fin_epoch * spe and (
+            root is None or self.chain.block_root_at_slot(slot) == root)
+        return version, finalized
+
+    def block_envelope(self, block_id: str) -> tuple[dict, str]:
+        """GET /eth/v2/beacon/blocks/{id} JSON body + consensus version."""
+        from .json_repr import container_json
+        root, blk = self._resolve_block(block_id)
+        version, finalized = self._block_meta(blk, root)
+        return ({"version": version, "execution_optimistic": False,
+                 "finalized": finalized, "data": container_json(blk)},
+                version)
+
+    def block_version(self, block_id: str) -> str:
+        """Consensus version only (cheap: no JSON rendering) for SSZ
+        responses' Eth-Consensus-Version header."""
+        _root, blk = self._resolve_block(block_id)
+        return type(blk).fork_name.name.lower()
+
+    def blinded_block_envelope(self, block_id: str) -> tuple[dict, str]:
+        from ..containers.blinded import blind_signed_block
+        from .json_repr import container_json
+        root, blk = self._resolve_block(block_id)
+        version, finalized = self._block_meta(blk, root)
+        if type(blk).fork_name >= ForkName.BELLATRIX:
+            blk = blind_signed_block(self.chain.T, blk)
+        return ({"version": version, "execution_optimistic": False,
+                 "finalized": finalized, "data": container_json(blk)},
+                version)
+
+    def block_attestations_v2(self, block_id: str) -> tuple[dict, str]:
+        """GET /eth/v2/beacon/blocks/{id}/attestations (fork-versioned)."""
+        from .json_repr import container_json
+        root, blk = self._resolve_block(block_id)
+        version, finalized = self._block_meta(blk, root)
+        atts = [container_json(a) for a in blk.message.body.attestations]
+        return ({"version": version, "execution_optimistic": False,
+                 "finalized": finalized, "data": atts}, version)
+
+    def state_version(self, state_id: str) -> str:
+        """Consensus version of a state (fork-versioned response headers
+        on the debug state endpoints)."""
+        return self._resolve_state(state_id).fork_name.name.lower()
+
+    def produce_block_envelope(self, slot: int, randao_reveal: bytes,
+                               graffiti: bytes | None = None
+                               ) -> tuple[dict, str]:
+        """GET /eth/v2/validator/blocks/{slot} JSON (+version header)."""
+        from .json_repr import container_json
+        block = self.produce_block(slot, randao_reveal, graffiti)
+        version = self.chain.spec.fork_name_at_slot(slot).name.lower()
+        return ({"version": version, "data": container_json(block)},
+                version)
+
+    def publish_block(self, signed_block,
+                      validation: str = "gossip") -> int:
+        """POST beacon/blocks with broadcast-validation semantics
+        (http_api/src/publish_blocks.rs:1-60):
+
+        - ``gossip`` (default): broadcast as soon as gossip checks pass;
+          a later full-import failure returns 202 (broadcast happened).
+        - ``consensus``: full state-transition import BEFORE broadcast;
+          any failure is 400 and nothing is broadcast.
+        - ``consensus_and_equivocation``: consensus + equivocation check
+          (our gossip verification already rejects repeat proposals, so
+          this is consensus with the equivocation error surfaced as 400).
+
+        Returns the HTTP status to send (200 or 202).  Broadcasting uses
+        the network hook (`self.publish_fn`, wired by the client
+        builder); absent a network the validation ordering still holds.
+        """
         from ..chain.errors import BlockError
+        if validation not in ("gossip", "consensus",
+                              "consensus_and_equivocation"):
+            raise ApiError(400, f"unknown broadcast_validation "
+                                f"{validation!r}")
+        chain = self.chain
+        broadcast = getattr(self, "publish_fn", None)
+        if validation == "gossip":
+            try:
+                chain.verify_block_for_gossip(signed_block)
+            except BlockError as e:
+                if e.kind == "already_known":
+                    root = htr(signed_block.message)
+                    if chain.fork_choice.contains_block(root):
+                        return 200
+                    # seen (a prior 202 broadcast) but never imported:
+                    # fall through and retry the import
+                else:
+                    raise ApiError(400, f"block rejected: {e}")
+            if broadcast is not None:
+                broadcast(signed_block)
+            try:
+                chain.process_block(signed_block,
+                                    proposal_already_verified=True)
+            except BlockError:
+                return 202            # broadcast, but not importable yet
+            return 200
+        # consensus / consensus_and_equivocation: import fully first
         try:
-            self.chain.process_block(signed_block)
+            chain.process_block(signed_block)
         except BlockError as e:
             raise ApiError(400, f"block rejected: {e}")
+        if broadcast is not None:
+            broadcast(signed_block)
+        return 200
 
     # -- validator duties ----------------------------------------------------
 
@@ -788,7 +898,10 @@ class ApiBackend:
         if payload is None:
             raise ApiError(400, "unknown payload for blinded block")
         full = unblind_signed_block(chain.T, signed_blinded, payload)
-        self.publish_block(full)
+        # consensus mode: import fully BEFORE broadcasting — an import
+        # failure raises, so the withheld payload survives for the VC's
+        # retry (gossip mode's 202 would silently drop it)
+        self.publish_block(full, validation="consensus")
         self._blinded_payloads.pop(header.block_hash, None)
 
     def sync_committee_contribution(self, slot: int, subcommittee: int,
@@ -1035,6 +1148,8 @@ class ApiBackend:
                 epoch >= st.validators.withdrawable_epoch[idx]),
             "is_active_unslashed_in_current_epoch": active
             and not bool(st.validators.slashed[idx]),
+            "current_epoch_effective_balance_gwei":
+                str(int(st.validators.effective_balance[idx])),
             "is_active_unslashed_in_previous_epoch": active
             and not bool(st.validators.slashed[idx]),
             "is_previous_epoch_target_attester": bool(flags & 0b010),

@@ -18,6 +18,25 @@ from ..utils.log_buffer import global_log_buffer, to_sse
 from .backend import ApiBackend, ApiError
 
 
+class Resp:
+    """Route result with content negotiation: a JSON payload producer, an
+    optional consensus version (sent as the Eth-Consensus-Version
+    response header, the fork-versioned-header semantics of the v2
+    endpoints) and an optional SSZ producer served when the client sends
+    `Accept: application/octet-stream` (http_api's ssz/json negotiation,
+    common/eth2 get_*_ssz).  Producers are LAZY: an SSZ request must not
+    pay for JSON rendering (or re-produce a block) and vice versa.
+    payload=None with an ssz producer marks an SSZ-only endpoint served
+    raw regardless of Accept."""
+
+    def __init__(self, payload=None, version=None, ssz=None,
+                 payload_fn=None):
+        self.payload = payload
+        self.payload_fn = payload_fn   # () -> (json_payload, version)
+        self.version = version         # str or callable () -> str
+        self.ssz = ssz                 # callable () -> bytes, or bytes
+
+
 def _att_data_json(backend: ApiBackend, q) -> dict:
     data = backend.attestation_data(int(q["slot"][0]),
                                     int(q["committee_index"][0]))
@@ -96,7 +115,15 @@ POST_ROUTES = [
     "/eth/v1/validator/sync_committee_subscriptions",
     "/lighthouse/database/reconstruct",
     "/lighthouse/compaction",
+    "/lighthouse/liveness",
 ]
+
+
+def _versioned(envelope_fn, ssz_fn=None, version_fn=None) -> Resp:
+    """Lazy fork-versioned route result: `envelope_fn()` -> (json, version)
+    runs only for JSON responses; `ssz_fn()` only for SSZ responses (with
+    `version_fn()` supplying the header cheaply)."""
+    return Resp(payload_fn=envelope_fn, version=version_fn, ssz=ssz_fn)
 
 
 def build_get_routes(backend: ApiBackend):
@@ -146,6 +173,57 @@ def build_get_routes(backend: ApiBackend):
         (re.compile(r"^/lighthouse/head_root$"),
          lambda m, q: {"data": {
              "root": "0x" + backend.head_root().hex()}}),
+        # -- fork-versioned block/state endpoints (JSON + SSZ negotiated,
+        #    Eth-Consensus-Version response headers) --
+        (re.compile(r"^/eth/v2/beacon/blocks/([^/]+)$"),
+         lambda m, q: _versioned(
+             lambda: backend.block_envelope(m[1]),
+             lambda: backend.block_ssz(m[1]),
+             lambda: backend.block_version(m[1]))),
+        (re.compile(r"^/eth/v1/beacon/blocks/([^/]+)$"),
+         lambda m, q: _versioned(
+             lambda: backend.block_envelope(m[1]),
+             lambda: backend.block_ssz(m[1]),
+             lambda: backend.block_version(m[1]))),
+        (re.compile(r"^/eth/v1/beacon/blinded_blocks/([^/]+)$"),
+         lambda m, q: _versioned(
+             lambda: backend.blinded_block_envelope(m[1]),
+             lambda: backend.blinded_block_ssz(m[1]),
+             lambda: backend.block_version(m[1]))),
+        (re.compile(r"^/eth/v2/beacon/blocks/([^/]+)/attestations$"),
+         lambda m, q: _versioned(
+             lambda: backend.block_attestations_v2(m[1]))),
+        (re.compile(r"^/eth/v2/validator/blocks/(\d+)$"),
+         lambda m, q: _versioned(
+             lambda: backend.produce_block_envelope(
+                 int(m[1]), bytes.fromhex(q["randao_reveal"][0][2:]),
+                 bytes.fromhex(q["graffiti"][0][2:])
+                 if "graffiti" in q else None),
+             lambda: backend.produce_block_ssz(
+                 int(m[1]), bytes.fromhex(q["randao_reveal"][0][2:]),
+                 bytes.fromhex(q["graffiti"][0][2:])
+                 if "graffiti" in q else None),
+             lambda: backend.chain.spec.fork_name_at_slot(
+                 int(m[1])).name.lower())),
+        (re.compile(r"^/eth/v1/beacon/light_client/bootstrap/([^/]+)$"),
+         lambda m, q: {"data": backend.light_client_bootstrap(m[1])}),
+        (re.compile(r"^/eth/v1/beacon/pool/bls_to_execution_changes$"),
+         lambda m, q: {"data": backend.pool_ops(
+             "bls_to_execution_changes")}),
+        (re.compile(
+            r"^/eth/v1/beacon/states/([^/]+)/expected_withdrawals$"),
+         lambda m, q: {"data": backend.expected_withdrawals(m[1])}),
+        (re.compile(
+            r"^/eth/v1/beacon/states/([^/]+)/pending_consolidations$"),
+         lambda m, q: {"data": backend.pending_queue(
+             m[1], "pending_consolidations")}),
+        (re.compile(
+            r"^/eth/v1/beacon/states/([^/]+)/pending_partial_withdrawals$"),
+         lambda m, q: {"data": backend.pending_queue(
+             m[1], "pending_partial_withdrawals")}),
+        (re.compile(r"^/lighthouse/beacon/states/([^/]+)/ssz$"),
+         lambda m, q: Resp(version=lambda: backend.state_version(m[1]),
+                           ssz=lambda: backend.debug_state_ssz(m[1]))),
         # -- beacon: blocks/headers/blobs --
         (re.compile(r"^/eth/v1/beacon/blocks/([^/]+)/root$"),
          lambda m, q: {"data": {
@@ -288,10 +366,6 @@ def build_get_routes(backend: ApiBackend):
              backend.validators("head"))}}),
         (re.compile(r"^/lighthouse/ui/health$"),
          lambda m, q: {"data": {"healthy": backend.is_healthy()}}),
-        # -- full block retrieval (v2 serves raw SSZ via the do_GET
-        # special case; this is the legacy JSON alias) --
-        (re.compile(r"^/eth/v1/beacon/blocks/([^/]+)$"),
-         lambda m, q: {"data": {"ssz": backend.block_ssz(m[1]).hex()}}),
         (re.compile(r"^/eth/v2/debug/beacon/heads$"),
          lambda m, q: {"data": backend.debug_heads()}),
         # -- builder/withdrawals + identities --
@@ -369,13 +443,44 @@ def _make_handler(backend: ApiBackend):
         def log_message(self, *args):  # quiet
             pass
 
-        def _json(self, status: int, obj) -> None:
+        def _json(self, status: int, obj,
+                  version: str | None = None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
+            if version is not None:
+                self.send_header("Eth-Consensus-Version", version)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _raw(self, raw: bytes, version: str | None = None) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            if version is not None:
+                self.send_header("Eth-Consensus-Version", version)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def _negotiate(self, out: Resp) -> None:
+            """JSON by default; SSZ when the client Accepts octet-stream
+            and the route has an SSZ form (fork version header on both).
+            SSZ-only routes (no JSON payload) serve raw unconditionally."""
+            accept = self.headers.get("Accept", "")
+            ssz_only = out.payload is None and out.payload_fn is None
+            if out.ssz is not None and (
+                    ssz_only or "application/octet-stream" in accept):
+                raw = out.ssz() if callable(out.ssz) else out.ssz
+                version = out.version() if callable(out.version) \
+                    else out.version
+                return self._raw(raw, version)
+            payload, version = out.payload, out.version
+            if out.payload_fn is not None:
+                payload, version = out.payload_fn()
+            elif callable(version):
+                version = version()
+            return self._json(200, payload, version=version)
 
         def do_GET(self):
             url = urlparse(self.path)
@@ -399,49 +504,6 @@ def _make_handler(backend: ApiBackend):
                 except Exception:
                     backend.chain.events.unsubscribe(sub)
                 return
-            if url.path.startswith("/eth/v2/validator/blocks/"):
-                slot = int(url.path.rsplit("/", 1)[1])
-                reveal = bytes.fromhex(q["randao_reveal"][0][2:])
-                graffiti = (bytes.fromhex(q["graffiti"][0][2:])
-                            if "graffiti" in q else None)
-                try:
-                    block = backend.produce_block(slot, reveal, graffiti)
-                except ApiError as e:
-                    return self._json(e.status, {"message": str(e)})
-                raw = serialize(type(block).ssz_type, block)
-                fork_name = backend.chain.spec.fork_name_at_slot(
-                    slot).name.lower()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/octet-stream")
-                self.send_header("Eth-Consensus-Version", fork_name)
-                self.send_header("Content-Length", str(len(raw)))
-                self.end_headers()
-                self.wfile.write(raw)
-                return
-            if url.path.startswith("/eth/v2/beacon/blocks/"):
-                block_id = url.path.rsplit("/", 1)[1]
-                try:
-                    raw = backend.block_ssz(block_id)
-                except ApiError as e:
-                    return self._json(e.status, {"message": str(e)})
-                self.send_response(200)
-                self.send_header("Content-Type", "application/octet-stream")
-                self.send_header("Content-Length", str(len(raw)))
-                self.end_headers()
-                self.wfile.write(raw)
-                return
-            if url.path.startswith("/eth/v1/beacon/blinded_blocks/"):
-                block_id = url.path.rsplit("/", 1)[1]
-                try:
-                    raw = backend.blinded_block_ssz(block_id)
-                except ApiError as e:
-                    return self._json(e.status, {"message": str(e)})
-                self.send_response(200)
-                self.send_header("Content-Type", "application/octet-stream")
-                self.send_header("Content-Length", str(len(raw)))
-                self.end_headers()
-                self.wfile.write(raw)
-                return
             if url.path == "/lighthouse/logs":
                 buf = global_log_buffer()
                 sub = buf.subscribe()
@@ -460,25 +522,50 @@ def _make_handler(backend: ApiBackend):
                 m = pat.match(url.path)
                 if m:
                     try:
-                        return self._json(200, fn(m, q))
+                        out = fn(m, q)
+                        if isinstance(out, Resp):
+                            return self._negotiate(out)
+                        return self._json(200, out)
                     except ApiError as e:
                         return self._json(e.status, {"message": str(e)})
                     except Exception as e:
                         return self._json(500, {"message": repr(e)})
             self._json(404, {"message": "route not found"})
 
+        def _block_fork(self, chain):
+            """Fork for decoding a posted block: the Eth-Consensus-Version
+            request header when given (SSZ POSTs per spec), else the
+            clock's fork."""
+            hdr = self.headers.get("Eth-Consensus-Version")
+            if hdr:
+                from ..specs.chain_spec import ForkName
+                try:
+                    return ForkName[hdr.upper()]
+                except KeyError:
+                    raise ApiError(400, f"unknown consensus version {hdr}")
+            return chain.spec.fork_name_at_slot(chain.slot())
+
         def do_POST(self):
             url = urlparse(self.path)
+            q = parse_qs(url.query)
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
             try:
                 chain = backend.chain
-                if url.path == "/eth/v1/beacon/blocks":
-                    fork = chain.spec.fork_name_at_slot(chain.slot())
-                    cls = chain.T.SignedBeaconBlock[fork]
+                if url.path in ("/eth/v1/beacon/blocks",
+                                "/eth/v2/beacon/blocks"):
+                    # broadcast-validation semantics
+                    # (http_api/src/publish_blocks.rs): gossip (default)
+                    # broadcasts after gossip checks and returns 202 when
+                    # full import then fails; consensus* import fully
+                    # BEFORE broadcasting and 400 without broadcast
+                    validation = q.get("broadcast_validation",
+                                       ["gossip"])[0]
+                    cls = chain.T.SignedBeaconBlock[self._block_fork(chain)]
                     signed = deserialize(cls.ssz_type, body)
-                    backend.publish_block(signed)
-                    return self._json(200, {})
+                    status = backend.publish_block(signed,
+                                                   validation=validation)
+                    return self._json(status, {})
                 m = re.match(r"^/eth/v1/validator/duties/attester/(\d+)$",
                              url.path)
                 if m:
@@ -611,6 +698,15 @@ def _make_handler(backend: ApiBackend):
                         body or b"{}").get("indices", [])]
                     return self._json(200, {
                         "data": backend.ui_validator_info(ids)})
+                if url.path == "/lighthouse/liveness":
+                    req = json.loads(body)
+                    epoch = int(req["epoch"])
+                    ids = [int(i) for i in req["indices"]]
+                    seen = backend.seen_liveness(ids, epoch)
+                    return self._json(200, {"data": [
+                        {"index": str(i), "epoch": str(epoch),
+                         "is_live": live}
+                        for i, live in zip(ids, seen)]})
                 m = re.match(
                     r"^/eth/v1/beacon/states/([^/]+)/validator_identities$",
                     url.path)
@@ -619,14 +715,6 @@ def _make_handler(backend: ApiBackend):
                     return self._json(200, {
                         "data": backend.validator_identities(
                             m[1], ids or None)})
-                if url.path == "/eth/v2/beacon/blocks":
-                    # the broadcast_validation query levels all map to our
-                    # full consensus validation in process_block
-                    fork = chain.spec.fork_name_at_slot(chain.slot())
-                    cls = chain.T.SignedBeaconBlock[fork]
-                    signed = deserialize(cls.ssz_type, body)
-                    backend.publish_block(signed)
-                    return self._json(200, {})
                 if url.path in ("/eth/v1/beacon/blinded_blocks",
                                 "/eth/v2/beacon/blinded_blocks"):
                     # SignedBlindedBeaconBlock SSZ: server-side unblinding
@@ -682,9 +770,6 @@ def _make_handler(backend: ApiBackend):
 EXTRA_ROUTES = [
     "/eth/v1/events",                         # SSE
     "/lighthouse/logs",                       # SSE log tail
-    "/eth/v2/validator/blocks/{slot}",        # raw-SSZ GET
-    "/eth/v2/beacon/blocks/{block_id}",       # raw-SSZ GET
-    "/eth/v1/beacon/blinded_blocks/{block_id}",  # raw-SSZ GET
     "/lighthouse/ui/validator_metrics",       # POST
     "/lighthouse/ui/validator_info",          # POST
     "/eth/v1/beacon/states/{state_id}/validator_identities",  # POST

@@ -38,15 +38,15 @@ class LocalNode:
 
 class GossipingBackend(ApiBackend):
     """API publish also floods the gossip network (http_api/src/
-    publish_blocks.rs -> network channel behavior)."""
+    publish_blocks.rs -> network channel behavior).  Block broadcast goes
+    through the backend's publish_fn hook so the round-4
+    broadcast-validation ordering applies (gossip mode broadcasts after
+    gossip checks; consensus mode only after full import)."""
 
     def __init__(self, chain, network: NetworkService):
         super().__init__(chain)
         self.network = network
-
-    def publish_block(self, signed_block) -> None:
-        super().publish_block(signed_block)
-        self.network.publish_block(signed_block)
+        self.publish_fn = network.publish_block
 
     def publish_attestation(self, attestation) -> None:
         super().publish_attestation(attestation)

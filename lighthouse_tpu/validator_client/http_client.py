@@ -37,6 +37,10 @@ class BeaconNodeHttpClient(BeaconNodeInterface):
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
         headers = {}
+        if raw:
+            # SSZ responses are opt-in since round 4 (the server
+            # negotiates JSON by default, per the Beacon API spec)
+            headers["Accept"] = "application/octet-stream"
         if json_body is not None:
             body = json.dumps(json_body).encode()
             headers["Content-Type"] = "application/json"

@@ -134,18 +134,25 @@ def test_block_retrieval_and_withdrawals_routes(api):
     identities, v2 production, electra v2 pool aliases."""
     h, srv = api
     from lighthouse_tpu.ssz import deserialize
-    # v2 serves raw SSZ (octet-stream, checkpoint-sync path); the legacy
-    # v1 JSON alias carries the same bytes hex-encoded
-    with urllib.request.urlopen(
-            f"http://127.0.0.1:{srv.port}/eth/v2/beacon/blocks/head") as r:
+    # v2 negotiates: JSON by default (with the fork-versioned header),
+    # raw SSZ under Accept: application/octet-stream (checkpoint sync)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/eth/v2/beacon/blocks/head",
+        headers={"Accept": "application/octet-stream"})
+    with urllib.request.urlopen(req) as r:
         raw = r.read()
         assert r.headers.get("Content-Type") == "application/octet-stream"
+        assert r.headers.get("Eth-Consensus-Version")
     fork = h.chain.spec.fork_name_at_slot(h.chain.slot())
     cls = h.chain.T.SignedBeaconBlock[fork]
     signed = deserialize(cls.ssz_type, raw)
     assert signed.message.slot == h.chain.head().head_state.slot
-    legacy = _get(srv, "/eth/v1/beacon/blocks/head")
-    assert legacy["data"]["ssz"] == raw.hex()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/eth/v2/beacon/blocks/head") as r:
+        env = json.loads(r.read())
+        assert r.headers.get("Eth-Consensus-Version") == env["version"]
+    assert env["data"]["message"]["slot"] ==         str(h.chain.head().head_state.slot)
+    assert env["finalized"] in (True, False)
     # identities + POST validator filters
     ids = _get(srv, "/eth/v1/beacon/states/head/validator_identities"
                     "?id=0&id=1")["data"]
@@ -246,9 +253,10 @@ def test_round3_post_routes(api):
 def test_blinded_block_get_route(api):
     h, srv = api
     # altair chain: blinded GET falls back to the full block SSZ
-    raw = urllib.request.urlopen(
-        f"http://127.0.0.1:{srv.port}/eth/v1/beacon/blinded_blocks/head"
-    ).read()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/eth/v1/beacon/blinded_blocks/head",
+        headers={"Accept": "application/octet-stream"})
+    raw = urllib.request.urlopen(req).read()
     from lighthouse_tpu.ssz import deserialize
     fork = h.chain.spec.fork_name_at_slot(h.chain.head().head_state.slot)
     blk = deserialize(h.chain.T.SignedBeaconBlock[fork].ssz_type, raw)
