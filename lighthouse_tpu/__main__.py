@@ -102,6 +102,33 @@ def main(argv=None):
     dbm_sub.add_parser("inspect")
     dbm_sub.add_parser("compact")
 
+    # validator_manager: bulk create/import/move (the reference's
+    # validator_manager crate surface)
+    vm = sub.add_parser("validator_manager", aliases=["vm"],
+                        help="bulk validator lifecycle tooling")
+    vm_sub = vm.add_subparsers(dest="vm_cmd", required=True)
+    vm_create = vm_sub.add_parser("create",
+                                  help="derive keystores from a seed")
+    vm_create.add_argument("--seed-hex", required=True)
+    vm_create.add_argument("--count", type=int, required=True)
+    vm_create.add_argument("--first-index", type=int, default=0)
+    vm_create.add_argument("--out-dir", required=True)
+    vm_create.add_argument("--password", default="lighthouse-tpu")
+    vm_import = vm_sub.add_parser("import",
+                                  help="import keystores into a datadir")
+    vm_import.add_argument("--keystore-dir", required=True)
+    vm_import.add_argument("--password", default="lighthouse-tpu")
+    vm_import.add_argument("--datadir", required=True)
+    vm_move = vm_sub.add_parser(
+        "move", help="move validators between datadirs w/ slashing history")
+    vm_move.add_argument("--src-datadir", required=True)
+    vm_move.add_argument("--dst-datadir", required=True)
+    vm_move.add_argument("--keystore-dir", required=True,
+                         help="dir holding the keystores to move")
+    vm_move.add_argument("--password", default="lighthouse-tpu")
+    vm_move.add_argument("--pubkeys", required=True,
+                         help="comma-separated 0x pubkeys")
+
     args = parser.parse_args(argv)
 
     if args.testnet_dir:
@@ -125,6 +152,50 @@ def main(argv=None):
         from .network.discovery import main as boot_main
         return boot_main(["--host", args.host, "--port",
                           str(args.boot_port)])
+    if args.cmd in ("validator_manager", "vm"):
+        return _run_validator_manager(spec, args)
+    return 1
+
+
+def _run_validator_manager(spec, args):
+    from . import validator_manager as vman
+    from .validator_client import ValidatorStore
+
+    def _store(datadir):
+        import os
+        from .validator_client import SlashingDatabase
+        os.makedirs(datadir, exist_ok=True)
+        db = SlashingDatabase(os.path.join(datadir,
+                                           "slashing_protection.sqlite"))
+        return ValidatorStore(spec, b"\x00" * 32, slashing_db=db)
+
+    if args.vm_cmd == "create":
+        out = vman.create_validators(
+            bytes.fromhex(args.seed_hex.removeprefix("0x")), args.count,
+            args.out_dir, args.password.encode(),
+            first_index=args.first_index)
+        print(f"created {len(out)} keystores in {args.out_dir}")
+        return 0
+    if args.vm_cmd == "import":
+        store = _store(args.datadir)
+        n = vman.import_validators(args.keystore_dir,
+                                   args.password.encode(), store)
+        print(f"imported {n} validators into {args.datadir}")
+        return 0
+    if args.vm_cmd == "move":
+        src = _store(args.src_datadir)
+        dst = _store(args.dst_datadir)
+        # keys live in keystores, not the datadir: load them into the
+        # source store first (the reference's move flow talks to a live
+        # VC keymanager; the offline equivalent is keystore-dir + both
+        # slashing databases)
+        vman.import_validators(args.keystore_dir, args.password.encode(),
+                               src)
+        pubkeys = [bytes.fromhex(p.strip().removeprefix("0x"))
+                   for p in args.pubkeys.split(",") if p.strip()]
+        n = vman.move_validators(src, dst, pubkeys, b"\x00" * 32)
+        print(f"moved {n} validators")
+        return 0
     return 1
 
 

@@ -725,10 +725,14 @@ def _make_handler(backend: ApiBackend):
                     except ApiError:
                         raise            # real blinded-flow failure
                     except Exception:
+                        # full-block compat fallback keeps the blinded
+                        # route's consensus semantics: import fully
+                        # before broadcasting, 400 on failure
                         fork = chain.spec.fork_name_at_slot(chain.slot())
                         cls = chain.T.SignedBeaconBlock[fork]
                         backend.publish_block(
-                            deserialize(cls.ssz_type, body))
+                            deserialize(cls.ssz_type, body),
+                            validation="consensus")
                     return self._json(200, {})
                 m = re.match(r"^/eth/v1/beacon/states/([^/]+)/validators$",
                              url.path)

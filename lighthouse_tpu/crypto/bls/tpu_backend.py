@@ -56,7 +56,8 @@ def lane_options() -> tuple[int, int]:
         else:
             import jax
             big = 10240 if jax.default_backend() != "cpu" else 64
-        small = min(128, big)
+        senv = os.environ.get("LHTPU_BLS_SMALL")
+        small = min(int(senv) if senv else min(128, big), big)
         _LANES = (small, big)
     return _LANES
 
@@ -96,44 +97,121 @@ class _PadCache:
 _PAD: _PadCache | None = None
 
 
+def parse_sets(backend, sets):
+    """Host parse shared by the single-device and mesh-sharded verifiers:
+    per-set pubkey aggregation (cached registry points) + compressed-
+    signature x/flag extraction with range checks.  Returns
+    (pks, sig_xs, flags, msgs) or None when any set is malformed (the
+    batch must verify False, not raise)."""
+    from ..bls12_381.fields import P as P_INT
+    pks, sig_xs, flags, msgs = [], [], [], []
+    try:
+        for s in sets:
+            if not s.pubkeys:
+                return None
+            pts = [backend._pk(p) for p in s.pubkeys]
+            agg = pts[0]
+            for p in pts[1:]:
+                agg = agg.add(p)
+            if agg.is_infinity():
+                return None
+            pks.append(agg)
+            cb = s.signature
+            if len(cb) != 96 or not (cb[0] & 0x80) or (cb[0] & 0x40):
+                return None           # malformed or infinity signature
+            c1 = int.from_bytes(bytes([cb[0] & 0x1f]) + cb[1:48], "big")
+            c0 = int.from_bytes(cb[48:96], "big")
+            if c0 >= P_INT or c1 >= P_INT:
+                return None
+            sig_xs.append((c0, c1))
+            flags.append(bool(cb[0] & 0x20))
+            msgs.append(s.message)
+    except ValueError:
+        return None
+    return pks, sig_xs, flags, msgs
+
+
+def host_prepare(pks, sig_xs, sig_flags, msgs, lanes: int, small: int):
+    """Pad/group host prep shared by both verifiers: same-message
+    grouping (segment layout for `g1_segment_sum`), RLC scalars, and the
+    padded device input arrays (cached generator constants on padding
+    lanes).  Returns a dict of arrays + layout."""
+    import secrets
+
+    from ...ops import bigint as bi
+    from ...ops import bls12_381 as k
+    from ..bls12_381.hash_to_curve import DST_POP
+
+    global _PAD
+    if _PAD is None:
+        _PAD = _PadCache()
+    m = len(pks)
+    pad = lanes - m
+    groups: dict[bytes, int] = {}
+    gid = [groups.setdefault(msg, len(groups)) for msg in msgs]
+    n_groups = len(groups)
+    msg_lanes = small if n_groups <= small else lanes
+    order = sorted(range(m), key=lambda i: gid[i])
+    starts = np.zeros(lanes, dtype=np.int32)
+    ends = np.zeros(msg_lanes, dtype=np.int32)
+    prev = None
+    for pos, i in enumerate(order):
+        if gid[i] != prev:
+            starts[pos] = 1
+            prev = gid[i]
+        ends[gid[i]] = pos
+    if pad:
+        starts[m] = 1                  # padding lanes: one junk segment
+    rands = [1] if m == 1 else [secrets.randbits(RAND_BITS) | 1
+                                for _ in range(m)]
+
+    sig_x_ints: list[int] = []
+    for c0, c1 in sig_xs:
+        sig_x_ints += [c0, c1]
+    sig_x_real = k.fp_encode(sig_x_ints).reshape(m, 2, bi.NLIMBS)
+    cat = np.concatenate
+    sig_x = cat([sig_x_real, _PAD.tile(_PAD.sig_x, pad)]) if pad \
+        else sig_x_real
+    flags = np.asarray(list(sig_flags) + [_PAD.flag] * pad, dtype=bool)
+    pkx_l, pky_l = [], []
+    for p in (pks[i] for i in order):
+        x, y = p.to_affine()
+        pkx_l.append(int(x))
+        pky_l.append(int(y))
+    pk_x_real, pk_y_real = k.fp_encode(pkx_l), k.fp_encode(pky_l)
+    pk_x = cat([pk_x_real, _PAD.tile(_PAD.pk_x, pad)]) if pad else pk_x_real
+    pk_y = cat([pk_y_real, _PAD.tile(_PAD.pk_y, pad)]) if pad else pk_y_real
+    umsgs = [None] * n_groups
+    for msg, g in groups.items():
+        umsgs[g] = msg
+    u0_real, u1_real = k.hash_to_field_host(umsgs, DST_POP)
+    upad = msg_lanes - n_groups
+    u0 = cat([u0_real, _PAD.tile(_PAD.u0, upad)]) if upad else u0_real
+    u1 = cat([u1_real, _PAD.tile(_PAD.u1, upad)]) if upad else u1_real
+    mask = np.zeros(msg_lanes + 1, dtype=bool)
+    mask[:n_groups] = True
+    mask[-1] = True                   # the aggregate/-G1 lane is real
+    return {
+        "sig_x": sig_x, "flags": flags, "pk_x": pk_x, "pk_y": pk_y,
+        "u0": u0, "u1": u1, "starts": starts, "ends": ends, "mask": mask,
+        "pk_rands": [rands[i] for i in order] + [0] * pad,
+        "sig_rands": list(rands) + [0] * pad,
+        "n_groups": n_groups, "msg_lanes": msg_lanes,
+    }
+
+
 class TpuBackend(PythonBackend):
     name = "tpu"
 
     def verify_signature_sets(self, sets: list[SignatureSet]) -> bool:
-        from ..bls12_381.fields import P as P_INT
         if not sets:
             return False
-
-        # host: aggregate (cached) pubkeys; parse signature x-coords
-        n = len(sets)
-        pks = []
-        sig_xs: list[tuple[int, int]] = []
-        sig_flags: list[bool] = []
-        try:
-            for s in sets:
-                if not s.pubkeys:
-                    return False
-                pk_pts = [self._pk(p) for p in s.pubkeys]
-                agg = pk_pts[0]
-                for p in pk_pts[1:]:
-                    agg = agg.add(p)
-                if agg.is_infinity():
-                    return False
-                pks.append(agg)
-                cb = s.signature
-                if len(cb) != 96 or not (cb[0] & 0x80) or (cb[0] & 0x40):
-                    return False          # malformed or infinity signature
-                c1 = int.from_bytes(bytes([cb[0] & 0x1f]) + cb[1:48], "big")
-                c0 = int.from_bytes(cb[48:96], "big")
-                if c0 >= P_INT or c1 >= P_INT:
-                    return False
-                sig_xs.append((c0, c1))
-                sig_flags.append(bool(cb[0] & 0x20))
-        except ValueError:
+        parsed = parse_sets(self, sets)
+        if parsed is None:
             return False
-
-        msgs = [s.message for s in sets]
+        pks, sig_xs, sig_flags, msgs = parsed
         small, big = lane_options()
+        n = len(sets)
         for i in range(0, n, big):
             m = min(big, n - i)
             lanes = small if m <= small else big
@@ -152,88 +230,22 @@ class TpuBackend(PythonBackend):
         message are folded into one pairing pair via
         Σᵢ rᵢ·e(Pᵢ, H(m)) = e(Σᵢ rᵢPᵢ, H(m)) — a 10k gossip attestation
         batch has ~128 distinct AttestationData messages, so hashing and
-        the Miller loop run per-message, not per-set (the two stages are
-        70% of per-lane cost).  The per-message sums of RLC-scaled
-        pubkeys are a log-depth segmented reduction on device
-        (`g1_segment_sum`)."""
+        the Miller loop (70% of per-lane cost) run at the SMALL static
+        shape when the distinct messages fit (host prep + segment layout
+        shared with the mesh-sharded verifier in `host_prepare`)."""
         import jax.numpy as jnp
 
         from ...ops import bls12_381 as k
         from ...ops import bigint as bi
         from ..bls12_381 import G1_GENERATOR
-        from ..bls12_381.hash_to_curve import DST_POP
 
-        global _PAD
-        if _PAD is None:
-            _PAD = _PadCache()
-        m = len(pks)
-        pad = lanes - m
+        prep = host_prepare(pks, sig_xs, sig_flags, msgs, lanes,
+                            lane_options()[0])
 
-        # ---- host: group sets by message -----------------------------------
-        groups: dict[bytes, int] = {}
-        gid = []
-        for msg in msgs:
-            g = groups.setdefault(msg, len(groups))
-            gid.append(g)
-        n_groups = len(groups)
-        # lane order sorted by group (stable) so segments are contiguous;
-        # the permutation is applied consistently to (pubkey, scalar)
-        # pairs, so each set keeps ITS random scalar on both sides
-        order = sorted(range(m), key=lambda i: gid[i])
-        starts = np.zeros(lanes, dtype=np.int32)
-        ends = np.zeros(lanes, dtype=np.int32)
-        prev = None
-        for pos, i in enumerate(order):
-            if gid[i] != prev:
-                starts[pos] = 1
-                prev = gid[i]
-            ends[gid[i]] = pos
-        if pad:
-            starts[m] = 1                  # padding lanes: one junk segment
-
-        # RLC scalars: odd 64-bit randoms for real lanes (scalar 1 when
-        # the chunk holds a single real set — no combination to
-        # randomize), 0 for padding lanes => scaled points are infinity
-        rands = ([1] if m == 1 else
-                 [secrets.randbits(RAND_BITS) | 1 for _ in range(m)])
-
-        sig_x_ints: list[int] = []
-        for c0, c1 in sig_xs:
-            sig_x_ints += [c0, c1]
-        sig_x_real = k.fp_encode(sig_x_ints).reshape(m, 2, bi.NLIMBS)
-        sig_x = np.concatenate([sig_x_real, _PAD.tile(_PAD.sig_x, pad)]) \
-            if pad else sig_x_real
-        flags = np.asarray(list(sig_flags) + [_PAD.flag] * pad, dtype=bool)
-
-        pk_x_real, pk_y_real = _encode_g1_batch(
-            k, [pks[i] for i in order])
-        pk_x = np.concatenate([pk_x_real, _PAD.tile(_PAD.pk_x, pad)]) \
-            if pad else pk_x_real
-        pk_y = np.concatenate([pk_y_real, _PAD.tile(_PAD.pk_y, pad)]) \
-            if pad else pk_y_real
-
-        # hash only the UNIQUE messages (group slot g holds H(m_g))
-        umsgs = [None] * n_groups
-        for msg, g in groups.items():
-            umsgs[g] = msg
-        u0_real, u1_real = k.hash_to_field_host(umsgs, DST_POP)
-        upad = lanes - n_groups
-        u0 = np.concatenate([u0_real, _PAD.tile(_PAD.u0, upad)]) \
-            if upad else u0_real
-        u1 = np.concatenate([u1_real, _PAD.tile(_PAD.u1, upad)]) \
-            if upad else u1_real
-
-        pk_rands = [rands[i] for i in order] + [0] * pad
-        sig_rands = list(rands) + [0] * pad
-        mask = np.zeros(lanes + 1, dtype=bool)
-        mask[:n_groups] = True
-        mask[-1] = True                   # the aggregate/-G1 lane is real
-
-        # ---- device --------------------------------------------------------
-        # signature decompression + subgroup check (generator padding
-        # keeps both checks uniformly True on padded lanes)
-        sig_x = jnp.asarray(sig_x)
-        sig_y, on_curve = k.g2_decompress_batch(sig_x, flags)
+        # device: signature decompression + subgroup check (generator
+        # padding keeps both checks uniformly True on padded lanes)
+        sig_x = jnp.asarray(prep["sig_x"])
+        sig_y, on_curve = k.g2_decompress_batch(sig_x, prep["flags"])
         if not bool(np.asarray(on_curve).all()):
             return False
         one2 = jnp.asarray(np.broadcast_to(k.FP2_ONE, (lanes, 2, bi.NLIMBS)))
@@ -241,20 +253,23 @@ class TpuBackend(PythonBackend):
                 k.g2_in_subgroup_batch(sig_x, sig_y, one2)).all()):
             return False
 
-        # hash unique messages to G2 (host did only expand_message_xmd)
-        mx, my, mz = k.hash_to_g2_batch_from_u(u0, u1)
+        # device: hash unique messages to G2 (host did expand_message_xmd)
+        mx, my, mz = k.hash_to_g2_batch_from_u(prep["u0"], prep["u1"])
         msg_x, msg_y = k.jacobian_to_affine_fp2(mx, my, mz)
 
         one1 = np.broadcast_to(k.FP_ONE, (lanes, bi.NLIMBS))
 
         # RLC scaling (padded lanes scale to infinity)
         spx, spy, spz = k.g1_scalar_mul_jit(
-            pk_x, pk_y, one1, k.scalars_to_bits(pk_rands, RAND_BITS))
+            prep["pk_x"], prep["pk_y"], one1,
+            k.scalars_to_bits(prep["pk_rands"], RAND_BITS))
         ssx, ssy, ssz = k.g2_scalar_mul_jit(
-            sig_x, sig_y, one2, k.scalars_to_bits(sig_rands, RAND_BITS))
+            sig_x, sig_y, one2,
+            k.scalars_to_bits(prep["sig_rands"], RAND_BITS))
         # per-message pubkey sums (segmented log-depth reduction);
         # group g's sum lands in lane g
-        gpx, gpy, gpz = k.g1_segment_sum(spx, spy, spz, starts, ends)
+        gpx, gpy, gpz = k.g1_segment_sum(spx, spy, spz, prep["starts"],
+                                         prep["ends"])
         # aggregate scaled signatures (scan reduction, 2 cached programs)
         ax, ay, az = k.g2_sum(ssx, ssy, ssz)
 
@@ -271,7 +286,7 @@ class TpuBackend(PythonBackend):
         qx = jnp.concatenate([msg_x, aax[None]], axis=0)
         qy = jnp.concatenate([msg_y, aay[None]], axis=0)
         return bool(np.asarray(
-            k.pairing_check_batch(px, py, qx, qy, mask=mask)))
+            k.pairing_check_batch(px, py, qx, qy, mask=prep["mask"])))
 
 
 def _encode_g1_batch(k, points):

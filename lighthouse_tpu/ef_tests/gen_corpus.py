@@ -235,6 +235,7 @@ def gen_state_cases(root: Path) -> int:
         get_beacon_committee, get_beacon_proposer_index,
         get_total_active_balance,
     )
+    from . import scalar_spec
     h, spec = _mini_chain()
     T = h.T
     n = 0
@@ -255,6 +256,7 @@ def gen_state_cases(root: Path) -> int:
     w_ssz(d, "block.ssz_snappy", serialize(type(block).ssz_type, block))
     good = st.copy()
     blk.process_block_header(good, block)
+    scalar_spec.verify_block_header_op(st, block, good)
     _write_state(d, "post.ssz_snappy", good)
     n += 1
     d = wcase(root, "minimal", "altair", "operations", "block_header",
@@ -279,6 +281,7 @@ def gen_state_cases(root: Path) -> int:
                                                  att))
     good = st2.copy()
     blk.process_attestation(good, att, VerifySignatures.TRUE)
+    scalar_spec.verify_attestation_op(st2, att, good)
     _write_state(d, "post.ssz_snappy", good)
     n += 1
     d = wcase(root, "minimal", "altair", "operations", "attestation",
@@ -318,6 +321,7 @@ def gen_state_cases(root: Path) -> int:
           serialize(T.SignedVoluntaryExit.ssz_type, sve))
     good = st3.copy()
     blk.process_voluntary_exit(good, sve, VerifySignatures.TRUE)
+    scalar_spec.verify_voluntary_exit_op(st3, sve, good)
     _write_state(d, "post.ssz_snappy", good)
     n += 1
     d = wcase(root, "minimal", "altair", "operations", "voluntary_exit",
@@ -359,6 +363,8 @@ def gen_state_cases(root: Path) -> int:
           serialize(T.ProposerSlashing.ssz_type, ps))
     good = st4.copy()
     blk.process_proposer_slashing(good, ps, VerifySignatures.TRUE)
+    scalar_spec.verify_slashing_op(
+        st4, pidx, get_beacon_proposer_index(st4), good)
     _write_state(d, "post.ssz_snappy", good)
     n += 1
     d = wcase(root, "minimal", "altair", "operations", "proposer_slashing",
@@ -375,6 +381,16 @@ def gen_state_cases(root: Path) -> int:
                   (ep_state.current_epoch() + 1)
                   * spec.preset.slots_per_epoch - 1)
     for sub, fn in [
+        ("justification_and_finalization",
+         lambda s: ep.process_justification_and_finalization(s)),
+        ("inactivity_updates",
+         lambda s: ep._process_inactivity_updates(s)),
+        ("rewards_and_penalties",
+         lambda s: ep._process_rewards_and_penalties_altair(
+             s, s.fork_name, ep.get_total_active_balance(s))),
+        ("slashings",
+         lambda s: ep._process_slashings(
+             s, s.fork_name, ep.get_total_active_balance(s))),
         ("effective_balance_updates",
          lambda s: ep._process_effective_balance_updates(s)),
         ("slashings_reset", lambda s: ep._process_slashings_reset(s)),
@@ -390,6 +406,10 @@ def gen_state_cases(root: Path) -> int:
         _write_state(d, "pre.ssz_snappy", ep_state)
         post = ep_state.copy()
         fn(post)
+        # the expected post is only written once the INDEPENDENT scalar
+        # transcription agrees with the vectorized transition
+        # (de-circularization, scalar_spec.py)
+        scalar_spec.verify_epoch_subtransition(sub, ep_state, post)
         _write_state(d, "post.ssz_snappy", post)
         n += 1
 
@@ -402,6 +422,15 @@ def gen_state_cases(root: Path) -> int:
         w_yaml(d, "slots.yaml", k)
         post = s.copy()
         process_slots(post, post.slot + k)
+        if (s.slot + k) // spec.preset.slots_per_epoch > \
+                s.slot // spec.preset.slots_per_epoch:
+            # epoch crossed: scalar-verify the composed transition from
+            # the state at the boundary's last slot
+            boundary_pre = s.copy()
+            last = ((s.slot // spec.preset.slots_per_epoch + 1)
+                    * spec.preset.slots_per_epoch - 1)
+            process_slots(boundary_pre, last)
+            scalar_spec.verify_epoch_transition(boundary_pre, post)
         _write_state(d, "post.ssz_snappy", post)
         n += 1
     signed, _post = h.produce_signed_block()

@@ -14,9 +14,14 @@ Independence notes (per family — same discipline as gen_corpus.py):
 - merkle_proof / light_client proofs: branches assembled with hashlib
   from field roots; verification in the runner re-hashes bottom-up, so
   a wrong branch or root cannot self-validate.
-- finality/random/fork/genesis-initialization/sync post-states are
-  regression pins from this implementation (honest label; replaced by
-  real consensus-spec-tests tarballs when network access allows).
+- finality/random: every epoch transition the pinned chain crosses is
+  verified against the scalar spec at generation time (justification,
+  finalization, balances); per-block operations are scalar-verified in
+  the operations family.  fork: upgrades scalar-verified (version
+  rotation + field preservation).  genesis-initialization: registry
+  construction scalar-verified from the deposit rows.  sync +
+  fork_choice steps encode hand-specified behavioral expectations
+  (head/revert semantics), not implementation output.
 """
 from __future__ import annotations
 
@@ -323,6 +328,12 @@ def gen_fork(root) -> int:
         pre = h.chain.head().head_state.copy()
         post_state = pre.copy()
         getattr(upgrades, f"upgrade_to_{post}")(post_state)
+        from ..specs.chain_spec import ForkName
+        from . import scalar_spec
+        scalar_spec.verify_upgrade(
+            pre, post_state,
+            expected_prev=bytes(pre.fork.current_version),
+            expected_cur=spec.fork_version(ForkName[post.upper()]))
         d = wcase(root, "minimal", post, "fork", "fork", "pyspec_tests",
                   f"fork_base_{post}")
         w_yaml(d, "meta.yaml", {"fork": post})
@@ -348,6 +359,26 @@ def gen_finality_random(root) -> int:
         roots = h.extend_chain(blocks_n, attest=attest)
         blocks = [h.chain.store.get_block(r) for r in roots]
         post = h.chain.head().head_state
+        # de-circularization: every epoch transition the pinned chain
+        # crosses is verified against the INDEPENDENT scalar spec
+        # (justification bits, finalized checkpoint, balances,
+        # effective balances — scalar_spec.py); the per-block operations
+        # are scalar-verified by the operations family
+        from . import scalar_spec
+        for b in blocks:
+            bslot = int(b.message.slot)
+            if bslot % spe != 0:
+                continue
+            parent = h.chain.store.get_block(bytes(b.message.parent_root))
+            pstate = h.chain.store.get_hot_state(
+                bytes(parent.message.state_root))
+            if pstate is None:
+                continue
+            last = pstate.copy()
+            process_slots(last, bslot - 1)        # stays inside the epoch
+            crossed = last.copy()
+            process_slots(crossed, bslot)         # the verified crossing
+            scalar_spec.verify_epoch_transition(last, crossed)
         d = wcase(root, "minimal", "altair", runner, handler,
                   "pyspec_tests", f"{runner}_chain")
         w_yaml(d, "meta.yaml", {"blocks_count": len(blocks)})
@@ -378,6 +409,10 @@ def gen_genesis(root) -> int:
     ts = 1_600_000_000
     state = initialize_beacon_state_from_eth1(spec, block_hash, ts,
                                               deposits)
+    from . import scalar_spec
+    scalar_spec.verify_genesis_registry(
+        [(bytes(dep.data.pubkey), bytes(dep.data.withdrawal_credentials),
+          int(dep.data.amount)) for dep in deposits], state)
     d = wcase(root, "minimal", "phase0", "genesis", "initialization",
               "pyspec_tests", f"initialization_{n_keys}")
     w_yaml(d, "eth1.yaml", {"eth1_block_hash": "0x" + block_hash.hex(),

@@ -52,3 +52,118 @@ def sharded_pairing_check(mesh: Mesh, px, py, qx, qy,
     partials = jax.jit(fn)(px, py, qx, qy)     # [n_dev, 2, 3, 2, 32]
     out = final_exponentiation(fp12_product(partials))
     return fp12_eq(out[None], fp12_one_like((1,)))[0]
+
+
+def sharded_verify_signature_sets(mesh: Mesh, sets, lanes: int,
+                                  axis: str = "batch") -> bool:
+    """The FULL `verify_signature_sets` semantics over the device mesh
+    (VERDICT r3 "next" #6): per-set pubkey aggregation (host, cached
+    registry points), signature parsing + flag handling, device
+    decompression + psi subgroup checks, same-message grouping, per-lane
+    RLC scalar multiplications SHARDED over the mesh, the scaled-signature
+    sum via per-shard partial sums gathered over ICI, the segmented
+    per-message pubkey sums on the gathered scaled points, and the
+    sharded Miller loop + one replicated final exponentiation.
+
+    `lanes` must be a multiple of mesh[axis].  Returns the verification
+    bool; semantics are cross-checked against the single-device
+    `TpuBackend` in the driver dryrun and tests/test_parallel.py.
+    """
+    import numpy as np
+
+    import lighthouse_tpu.ops.bls12_381 as k
+    from lighthouse_tpu.ops import bigint as bi
+    from lighthouse_tpu.crypto.bls import PythonBackend
+    from lighthouse_tpu.crypto.bls.tpu_backend import (
+        host_prepare, parse_sets,
+    )
+    from lighthouse_tpu.crypto.bls12_381 import G1_GENERATOR
+
+    if not sets:
+        return False
+    n_dev = mesh.shape[axis]
+    assert lanes % n_dev == 0, "lanes must divide across the mesh"
+    parsed = parse_sets(PythonBackend(), sets)
+    if parsed is None:
+        return False                  # malformed input: reject, not raise
+    pks, sig_xs, flags_l, msgs = parsed
+    assert len(pks) <= lanes
+    # host prep shared with TpuBackend._verify_chunk; the sharded Miller
+    # runs at full `lanes` (the shard split must stay even), so no
+    # small-message-shape split here
+    prep = host_prepare(pks, sig_xs, flags_l, msgs, lanes, small=lanes)
+    mask = prep["mask"][:-1]          # per-message lanes (aggregate lane
+                                      # is appended below)
+
+    # ---- device: replicated validity checks + hash map -----------------
+    import jax.numpy as jnp
+    sig_x = jnp.asarray(prep["sig_x"])
+    sig_y, on_curve = k.g2_decompress_batch(sig_x, prep["flags"])
+    if not bool(np.asarray(on_curve).all()):
+        return False
+    one2 = jnp.asarray(np.broadcast_to(k.FP2_ONE, (lanes, 2, bi.NLIMBS)))
+    if not bool(np.asarray(k.g2_in_subgroup_batch(sig_x, sig_y,
+                                                  one2)).all()):
+        return False
+    mx, my, mz = k.hash_to_g2_batch_from_u(prep["u0"], prep["u1"])
+    msg_x, msg_y = k.jacobian_to_affine_fp2(mx, my, mz)
+
+    # ---- device: SHARDED RLC scalar muls -------------------------------
+    one1 = np.broadcast_to(k.FP_ONE, (lanes, bi.NLIMBS))
+    bits_pk = k.scalars_to_bits(prep["pk_rands"], 64)
+    bits_sig = k.scalars_to_bits(prep["sig_rands"], 64)
+    g1_sharded = jax.jit(shard_map(
+        k.g1_scalar_mul, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis))))
+    g2_sharded = jax.jit(shard_map(
+        k.g2_scalar_mul, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis))))
+    spx, spy, spz = g1_sharded(jnp.asarray(prep["pk_x"]),
+                               jnp.asarray(prep["pk_y"]),
+                               jnp.asarray(one1), jnp.asarray(bits_pk))
+    ssx, ssy, ssz = g2_sharded(sig_x, sig_y, one2, jnp.asarray(bits_sig))
+
+    # scaled-signature aggregate + per-message pubkey segment sums run on
+    # the gathered scaled points (ICI gather of [lanes] points)
+    ax, ay, az = k.g2_sum(ssx, ssy, ssz)
+    gpx, gpy, gpz = k.g1_segment_sum(spx, spy, spz, prep["starts"],
+                                     prep["ends"])
+    apx, apy = k.jacobian_to_affine_fp(gpx, gpy, gpz)
+    aax, aay = k.jacobian_to_affine_fp2(ax, ay, az)
+
+    # ---- device: SHARDED Miller + replicated final exp -----------------
+    # pad the (+1 aggregate) pair batch to a mesh multiple with masked
+    # identity lanes so the shard split stays even
+    total = lanes + 1
+    mpad = (-total) % n_dev
+    neg_g = G1_GENERATOR.neg().to_affine()
+    ngx = k.fp_encode([int(neg_g[0])] * (1 + mpad))
+    ngy = k.fp_encode([int(neg_g[1])] * (1 + mpad))
+    px = jnp.concatenate([apx, jnp.asarray(ngx)], axis=0)
+    py = jnp.concatenate([apy, jnp.asarray(ngy)], axis=0)
+    qx = jnp.concatenate([msg_x, jnp.broadcast_to(aax[None],
+                                                  (1 + mpad,) +
+                                                  aax.shape)], axis=0)
+    qy = jnp.concatenate([msg_y, jnp.broadcast_to(aay[None],
+                                                  (1 + mpad,) +
+                                                  aay.shape)], axis=0)
+    full_mask = np.zeros(total + mpad, dtype=bool)
+    full_mask[:lanes] = mask
+    full_mask[lanes] = True               # the one real aggregate lane
+
+    def _local_masked_product(lpx, lpy, lqx, lqy, lmask):
+        fs = miller_loop_batch(lpx, lpy, lqx, lqy)
+        one = fp12_one_like((fs.shape[0],))
+        import jax.numpy as jnp_
+        fs = jnp_.where(lmask[:, None, None, None, None], fs, one)
+        return fp12_product(fs)[None]
+
+    masked_fn = jax.jit(shard_map(
+        _local_masked_product, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(axis)))
+    partials = masked_fn(px, py, qx, qy, jnp.asarray(full_mask))
+    out = final_exponentiation(fp12_product(partials))
+    return bool(np.asarray(fp12_eq(out[None], fp12_one_like((1,)))[0]))

