@@ -56,17 +56,26 @@ def main():
     ok = tpu.verify_signature_sets(sets)
     cold_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    ok_warm = tpu.verify_signature_sets(sets)
-    warm_s = time.perf_counter() - t0
+    # LHTPU_10K_FAST=1: one pass only (the XLA CPU fallback runs ~4
+    # sigs/s, so the 3-pass protocol is ~2.5 h; the negative polarity is
+    # covered at smaller lanes by tests + the driver dryrun)
+    fast = bool(os.environ.get("LHTPU_10K_FAST"))
+    if fast:
+        ok_warm, warm_s = ok, cold_s
+        rejected, neg_s = None, 0.0
+    else:
+        t0 = time.perf_counter()
+        ok_warm = tpu.verify_signature_sets(sets)
+        warm_s = time.perf_counter() - t0
 
-    # negative: corrupt ONE mid-batch message; the whole batch must fail
-    bad = list(sets)
-    k = N // 2
-    bad[k] = SignatureSet(bad[k].signature, bad[k].pubkeys, b"\xee" * 32)
-    t0 = time.perf_counter()
-    rejected = not tpu.verify_signature_sets(bad)
-    neg_s = time.perf_counter() - t0
+        # negative: corrupt ONE mid-batch message; batch must fail
+        bad = list(sets)
+        k = N // 2
+        bad[k] = SignatureSet(bad[k].signature, bad[k].pubkeys,
+                              b"\xee" * 32)
+        t0 = time.perf_counter()
+        rejected = not tpu.verify_signature_sets(bad)
+        neg_s = time.perf_counter() - t0
 
     rec = {
         "n_sigs": N,
