@@ -83,7 +83,8 @@ def main():
         "lanes": static_lanes(),
         "platform": jax.default_backend(),
         "verify_ok": bool(ok) and bool(ok_warm),
-        "reject_ok": bool(rejected),
+        # None (json null) when LHTPU_10K_FAST skipped the negative pass
+        "reject_ok": None if rejected is None else bool(rejected),
         "sign_seconds": round(sign_s, 1),
         "cold_seconds": round(cold_s, 1),
         "warm_seconds": round(warm_s, 1),
