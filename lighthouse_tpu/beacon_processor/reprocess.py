@@ -31,6 +31,12 @@ class ReprocessQueue:
         self._by_root: dict[bytes, tuple[int, list]] = {}
         self._lock = threading.Lock()
         self.max_per_bucket = 1024
+        # Global bound across ALL by-root buckets: UNKNOWN_HEAD parks are
+        # taken before any signature check, so an attacker gossiping random
+        # roots must not open unbounded buckets inside the expiry window
+        # (reference: work_reprocessing_queue.rs MAXIMUM_QUEUED_ATTESTATIONS).
+        self.max_by_root_total = 16384
+        self._by_root_count = 0
         self.parked_total = 0
         self.replayed_total = 0
         self.expired_total = 0
@@ -54,11 +60,15 @@ class ReprocessQueue:
     def park_until_block(self, block_root: bytes, work,
                          current_slot: int = 0) -> None:
         with self._lock:
+            if self._by_root_count >= self.max_by_root_total:
+                self.refused_total += 1
+                return
             parked_at, bucket = self._by_root.get(block_root,
                                                   (current_slot, []))
             if len(bucket) < self.max_per_bucket:
                 bucket.append(work)
                 self.parked_total += 1
+                self._by_root_count += 1
             self._by_root[block_root] = (parked_at, bucket)
 
     def on_slot(self, slot: int) -> int:
@@ -72,6 +82,7 @@ class ReprocessQueue:
                 if parked_at + self.EXPIRY_SLOTS < slot:
                     self._by_root.pop(root)
                     self.expired_total += len(bucket)
+                    self._by_root_count -= len(bucket)
         for w in due:
             self._submit(w)
         self.replayed_total += len(due)
@@ -80,6 +91,7 @@ class ReprocessQueue:
     def on_block_imported(self, block_root: bytes) -> int:
         with self._lock:
             _at, due = self._by_root.pop(block_root, (0, []))
+            self._by_root_count -= len(due)
         for w in due:
             self._submit(w)
         self.replayed_total += len(due)

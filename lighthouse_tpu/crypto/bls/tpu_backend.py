@@ -50,14 +50,26 @@ def lane_options() -> tuple[int, int]:
     """(small, big) compiled batch shapes for this process."""
     global _LANES
     if _LANES is None:
-        env = os.environ.get("LHTPU_BLS_LANES")
-        if env:
-            big = max(1, int(env))
+        def _env_int(name):
+            raw = os.environ.get(name)
+            if not raw:
+                return None
+            try:
+                return int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"{name} must be an integer lane count, got {raw!r}"
+                ) from None
+        env = _env_int("LHTPU_BLS_LANES")
+        if env is not None:
+            big = max(1, env)
         else:
             import jax
             big = 10240 if jax.default_backend() != "cpu" else 64
-        senv = os.environ.get("LHTPU_BLS_SMALL")
-        small = min(int(senv) if senv else min(128, big), big)
+        senv = _env_int("LHTPU_BLS_SMALL")
+        # clamp to [1, big]: small <= 0 would silently disable the
+        # small-shape path with a nonsensical compiled shape
+        small = min(max(1, senv) if senv is not None else min(128, big), big)
         _LANES = (small, big)
     return _LANES
 

@@ -38,6 +38,12 @@ class BackfillSync:
         # [window_low, window_high) spans, high -> low as batch ids grow
         self._spans: dict[int, tuple[int, int]] = {}
         self._req_end: int | None = None      # exclusive top of next window
+        # (batch_id, peer) that last advanced the anchor, for fault
+        # attribution when the NEXT batch's top block fails to link: a
+        # peer that truncated its window's lower edge still hash-links
+        # and advances the anchor, leaving the gap inside ITS span
+        self._advanced_by: tuple[int, str] | None = None
+        self._rewindowed = False              # one re-window per advance
 
     # -- scheduling ----------------------------------------------------------
 
@@ -117,6 +123,22 @@ class BackfillSync:
                 expected_root = sb.message.parent_root
                 stored_here += 1
             if not ok:
+                if (stored_here == 0 and self._advanced_by is not None
+                        and self._advanced_by[0] != batch.id
+                        and not self._rewindowed):
+                    # nothing in THIS batch linked: either the batch that
+                    # advanced the anchor truncated its lower edge (gap in
+                    # ITS span) or this batch is garbage.  Blame is
+                    # ambiguous, so — like range_sync's previous-batch
+                    # PARENT_UNKNOWN rollback — penalize BOTH peers, then
+                    # re-window from the stored anchor so a truncated span
+                    # gets re-downloaded.
+                    self.ctx.penalize(self._advanced_by[1],
+                                      "truncated_batch")
+                    if batch.peer != self._advanced_by[1]:
+                        self.ctx.penalize(batch.peer, "bad_segment")
+                    self._rewindow()
+                    return
                 self.ctx.penalize(batch.peer, "bad_segment")
                 if batch.processing_failed() == BatchState.FAILED:
                     self.stopped = True
@@ -124,6 +146,8 @@ class BackfillSync:
             if blocks:
                 self.empty_windows = 0
                 self.stored += stored_here
+                self._advanced_by = (batch.id, batch.peer)
+                self._rewindowed = False
                 new_anchor = blocks[0].message.slot
                 self.ctx.set_backfill_anchor(new_anchor, expected_root)
                 if new_anchor == 0:
@@ -139,6 +163,17 @@ class BackfillSync:
                     return
             batch.processed()
             self.process_ptr += 1
+
+    def _rewindow(self) -> None:
+        """Drop all windows (incl. in-flight) and restart from the stored
+        anchor, so a span truncated by a lying peer gets re-downloaded."""
+        anchor = self._anchor()
+        self.batches.clear()
+        self._spans.clear()
+        self.requests.clear()         # stale responses are ignored
+        self.process_ptr = self.next_batch_id
+        self._req_end = anchor[0] if anchor else None
+        self._rewindowed = True
 
     @property
     def in_flight(self) -> int:

@@ -16,6 +16,7 @@ batch dimension:
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +25,45 @@ import numpy as np
 LIMB_BITS = 12
 NLIMBS = 32
 LIMB_MASK = (1 << LIMB_BITS) - 1
+
+# MXU re-limb mode (PERF_MODEL.md §3.2, VERDICT r4 next #1).
+#   0 — int32 schoolbook columns on the VPU (the r1-r4 kernel).
+#   1 — all three mont_mul products in 6-bit-digit space: operands split to
+#       64 int8 digits so column products lower to int8 contractions with
+#       int32 accumulation; the two REDC products (by the constants N' and
+#       p) become true [B,64]@[64,out] matmuls on the MXU.
+#   2 — hybrid: the bilinear a*b product stays on the int32 VPU path, only
+#       the shared-constant REDC products ride the MXU.
+# All modes are element-exact as field values (tests/test_bigint_kernel.py);
+# representations in [0,2p) may differ limb-wise between modes.
+def _mxu_mode_from_env() -> int:
+    raw = os.environ.get("LHTPU_BIGINT_MXU", "0") or "0"
+    try:
+        mode = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"LHTPU_BIGINT_MXU must be 0, 1 or 2, got {raw!r}") from None
+    if mode not in (0, 1, 2):
+        raise ValueError(f"LHTPU_BIGINT_MXU must be 0, 1 or 2, got {mode}")
+    return mode
+
+
+_MXU_MODE = _mxu_mode_from_env()
+
+
+def mxu_mode() -> int:
+    return _MXU_MODE
+
+
+def set_mxu_mode(mode: int) -> None:
+    """Switch the multiply lowering (0/1/2) and invalidate jit traces."""
+    global _MXU_MODE
+    mode = int(mode)
+    if mode not in (0, 1, 2):
+        raise ValueError(f"LHTPU_BIGINT_MXU mode must be 0/1/2, got {mode}")
+    if mode != _MXU_MODE:
+        _MXU_MODE = mode
+        jax.clear_caches()
 
 P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
 R_INT = 1 << (LIMB_BITS * NLIMBS)          # Montgomery radix 2^384
@@ -169,6 +209,90 @@ def _mul_columns(a: jax.Array, b: jax.Array, out_len: int) -> jax.Array:
     return out
 
 
+# --- 6-bit digit space (MXU modes; PERF_MODEL.md §3.2) ----------------------
+#
+# Each 12-bit limb splits into exactly two 6-bit digits, so a field element
+# is 64 little-endian digits.  Loose limbs up to 2^13-1 still split into
+# int8-safe digits (lo6 <= 63, hi7 <= 127) — the same [0, 2^13) nonnegative
+# bound the int32 column path relies on.  Digit products <= 127*127 summed
+# over <= 64 columns stay < 2^21, far inside int32; merging digit columns
+# back to limb positions (even + (odd << 6)) stays < 2^27, inside the
+# carry machinery's 2^29 budget.
+
+NDIGITS = 2 * NLIMBS
+DIGIT_BITS = LIMB_BITS // 2
+DIGIT_MASK = (1 << DIGIT_BITS) - 1
+
+_DIG_IDX = np.clip(
+    np.arange(2 * NDIGITS)[None, :] - np.arange(NDIGITS)[:, None],
+    0, NDIGITS - 1)                                     # [64, 128]: k - i
+_DIG_VALID = (
+    (np.arange(2 * NDIGITS)[None, :] - np.arange(NDIGITS)[:, None] >= 0)
+    & (np.arange(2 * NDIGITS)[None, :] - np.arange(NDIGITS)[:, None]
+       < NDIGITS)).astype(np.int8)
+
+
+def _digits6(x: jax.Array) -> jax.Array:
+    """[..., 32] int32 limbs (in [0, 2^13)) -> [..., 64] int8 digits."""
+    lo = x & DIGIT_MASK
+    hi = x >> DIGIT_BITS
+    out = jnp.stack([lo, hi], axis=-1).reshape(*x.shape[:-1], NDIGITS)
+    return out.astype(jnp.int8)
+
+
+def _from_digits6(cols: jax.Array) -> jax.Array:
+    """Un-carried digit columns [..., 2L] int32 -> limb columns [..., L]."""
+    return cols[..., 0::2] + (cols[..., 1::2] << DIGIT_BITS)
+
+
+def _digits6_host(limbs: np.ndarray) -> np.ndarray:
+    out = np.zeros(NDIGITS, dtype=np.int64)
+    for i, l in enumerate(np.asarray(limbs, dtype=np.int64)):
+        out[2 * i] = l & DIGIT_MASK
+        out[2 * i + 1] = l >> DIGIT_BITS
+    return out
+
+
+def toeplitz6(limbs: np.ndarray, out_digits: int) -> np.ndarray:
+    """Constant-operand digit Toeplitz matrix T[i, k] = digit[k-i], so the
+    column product with constant c is the true matmul  x_digits @ T  — the
+    MXU-shaped [B, 64] @ [64, out] contraction (M = flattened batch)."""
+    d = _digits6_host(limbs)
+    assert int(d.max()) <= DIGIT_MASK  # constants are canonical
+    T = np.zeros((NDIGITS, out_digits), dtype=np.int8)
+    for i in range(NDIGITS):
+        hi = min(out_digits, i + NDIGITS)
+        T[i, i:hi] = d[:hi - i]
+    return T
+
+
+_NPRIME_T6 = toeplitz6(NPRIME_LIMBS, NDIGITS)           # low product, mod R
+_P_T6 = toeplitz6(P_LIMBS, 2 * NDIGITS)                 # full product
+
+
+def _mul_columns_digits(a: jax.Array, b: jax.Array, out_len: int) -> jax.Array:
+    """Bilinear schoolbook columns in 6-bit digit space -> limb columns.
+
+    Same Toeplitz-expansion shape as `_mul_columns` but with int8 operands
+    so the contraction lowers to the MXU's int8 path (int32 accumulation).
+    """
+    nd = 2 * out_len
+    ad = _digits6(a)
+    bd = _digits6(b)
+    bmat = bd[..., _DIG_IDX[:, :nd]] * _DIG_VALID[:, :nd]
+    cols = jnp.einsum("...i,...ik->...k", ad, bmat,
+                      preferred_element_type=jnp.int32)
+    return _from_digits6(cols)
+
+
+def _mul_const_digits(x: jax.Array, T: np.ndarray) -> jax.Array:
+    """Shared-constant product: digit matmul against a Toeplitz constant."""
+    xd = _digits6(x)
+    cols = jnp.einsum("...i,ik->...k", xd, jnp.asarray(T),
+                      preferred_element_type=jnp.int32)
+    return _from_digits6(cols)
+
+
 def mul_wide(a: jax.Array, b: jax.Array) -> jax.Array:
     """Full product in 64 carried limbs (inputs loose < 2^12+eps)."""
     cols = _mul_columns(a, b, 2 * NLIMBS)
@@ -192,12 +316,33 @@ def mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
     exact either way, and a loose-limbed m is still == t*N' mod R as a
     value once the top limb is masked, which is all REDC requires; the
     final exact carry then lands the zero low half + canonical high half.
+
+    The multiply lowering is picked at trace time by `mxu_mode()` (env
+    LHTPU_BIGINT_MXU): mode 1/2 route the REDC products — whose second
+    operand is the shared constant N' or p — through true int8 digit
+    matmuls for the MXU; mode 1 also digit-izes the bilinear a*b.
+    Truncating the N' product at 32 limb columns (VPU) vs 64 digit
+    columns (digit path) yields different integers m that are congruent
+    mod R, so the modes agree as field values but may return different
+    representatives in [0, 2p).
     """
-    t = _carry_pass(_carry_pass(_mul_columns(a, b, 2 * NLIMBS)))
-    m = _carry_pass(_carry_pass(
-        _mul_columns(t[..., :NLIMBS], jnp.asarray(NPRIME_LIMBS), NLIMBS)))
+    mode = _MXU_MODE
+    if mode == 1:
+        t_cols = _mul_columns_digits(a, b, 2 * NLIMBS)
+    else:
+        t_cols = _mul_columns(a, b, 2 * NLIMBS)
+    t = _carry_pass(_carry_pass(t_cols))
+    if mode:
+        m_cols = _mul_const_digits(t[..., :NLIMBS], _NPRIME_T6)
+    else:
+        m_cols = _mul_columns(t[..., :NLIMBS], jnp.asarray(NPRIME_LIMBS),
+                              NLIMBS)
+    m = _carry_pass(_carry_pass(m_cols))
     m = m.at[..., -1].set(m[..., -1] & LIMB_MASK)   # value mod R
-    mp = _mul_columns(m, jnp.asarray(P_LIMBS), 2 * NLIMBS)
+    if mode:
+        mp = _mul_const_digits(m, _P_T6)
+    else:
+        mp = _mul_columns(m, jnp.asarray(P_LIMBS), 2 * NLIMBS)
     s = normalize(t + mp)
     # low half of s is zero by construction; take the high half
     return s[..., NLIMBS:]

@@ -77,6 +77,49 @@ def test_reduce_wide():
         assert bi.from_limbs(out[i]) == vals[i] % P
 
 
+@pytest.mark.parametrize("mode", [1, 2])
+def test_mont_mul_mxu_modes_match_python(mode):
+    """The int8-digit (MXU) lowerings agree with the oracle as field values.
+
+    Representations in [0,2p) may differ limb-wise from mode 0 (the REDC
+    m differs by a multiple of R between column truncations) — compare
+    canonical values, and push through a mul/add/sub chain so loose and
+    negative-top-limb inputs hit the digit split too.
+    """
+    n = 12
+    va, a = rand_batch(n)
+    vb, b = rand_batch(n)
+    try:
+        bi.set_mxu_mode(mode)
+        am = bi.mont_from_int_limbs(a)
+        bm = bi.mont_from_int_limbs(b)
+        cm = bi.mont_mul(am, bm)
+        c = np.asarray(bi.mont_to_int_limbs(cm))
+        for i in range(n):
+            assert bi.from_limbs(c[i]) == va[i] * vb[i] % P, (mode, i)
+        # chain: exercises loose limbs incl. the negative-top-limb regime
+        acc, expect = am, list(va)
+        for _ in range(20):
+            acc = bi.mont_mul(bi.sub_mod(acc, bm), am)
+            expect = [(e - vbi) * vai % P
+                      for e, vai, vbi in zip(expect, va, vb)]
+            assert np.abs(np.asarray(acc)).max() < (1 << 13)
+        out = np.asarray(bi.mont_to_int_limbs(acc))
+        for i in range(n):
+            assert bi.from_limbs(out[i]) == expect[i], (mode, i)
+    finally:
+        bi.set_mxu_mode(0)
+
+
+def test_digit_split_roundtrip_signed():
+    x = np.array([[0, 63, 64, 4095, 4099, 8191, -1, -800, -8192]
+                  + [0] * 23], np.int32)
+    d = np.asarray(bi._digits6(x)).astype(np.int64)
+    lo, hi = d[..., 0::2], d[..., 1::2]
+    assert ((lo + (hi << bi.DIGIT_BITS)) == x).all()
+    assert d.max() <= 127 and d.min() >= -128
+
+
 def test_chained_muls_stay_bounded():
     """Stress the [0,2p) invariant through a long mul/add chain."""
     va, a = rand_batch(4)

@@ -184,3 +184,55 @@ def test_device_hash_to_g2_matches_oracle():
         X, Y = hash_to_g2(m).to_affine()
         assert (axl[2 * i], axl[2 * i + 1], ayl[2 * i], ayl[2 * i + 1]) == \
             (int(X.c0), int(X.c1), int(Y.c0), int(Y.c1))
+
+
+@pytest.mark.parametrize("mode", [1, 2])
+def test_mxu_digit_modes_through_curve_ops(mode):
+    """The LHTPU_BIGINT_MXU digit lowerings push exactly through the tower
+    and curve layers (fp2 mul/inv, G1 scalar mul) — small programs, always
+    run; the full pairing under mode 1 is the gated slow test below."""
+    import os
+    a = rand_fp2(4)
+    b = rand_fp2(4)
+    try:
+        bi.set_mxu_mode(mode)
+        prod = k.fp2_mul(k.fp2_encode(a), k.fp2_encode(b))
+        inv = k.fp2_inv(k.fp2_encode(a))
+        for i in range(4):
+            want = a[i] * b[i]
+            assert k.fp_decode(prod[i]) == [int(want.c0), int(want.c1)]
+            winv = a[i].inv()
+            assert k.fp_decode(inv[i]) == [int(winv.c0), int(winv.c1)]
+        scalars = [5, 2**61 - 1]
+        x, y = _encode_g1([G1_GENERATOR] * 2)
+        z = np.broadcast_to(k.FP_ONE, (2, bi.NLIMBS))
+        sx, sy, sz = k.g1_scalar_mul(x, y, z, k.scalars_to_bits(scalars, 64))
+        ax, ay = k.jacobian_to_affine_fp(sx, sy, sz)
+        for i, s in enumerate(scalars):
+            want = G1_GENERATOR.mul(s).to_affine()
+            assert k.fp_decode(ax[i])[0] == int(want[0])
+            assert k.fp_decode(ay[i])[0] == int(want[1])
+    finally:
+        bi.set_mxu_mode(0)
+
+
+def test_mxu_mode_full_pairing_slow():
+    """Full pairing check under LHTPU_BIGINT_MXU=1 (gated: cold compiles of
+    the Miller/final-exp programs take minutes on the CPU test backend)."""
+    import os
+    if not os.environ.get("LHTPU_SLOW_TESTS"):
+        pytest.skip("full-pairing MXU-mode test (set LHTPU_SLOW_TESTS=1)")
+    sk = keygen_interop(5)
+    pk = sk_to_pk(sk)
+    msg = b"\x77" * 32
+    sig = sign(sk, msg)
+    h = hash_to_g2(msg)
+    try:
+        bi.set_mxu_mode(1)
+        px, py = _encode_g1([G1_GENERATOR.neg(), pk])
+        qx, qy = _encode_g2([sig, h])
+        assert bool(np.asarray(k.pairing_check_batch(px, py, qx, qy)))
+        qx2, qy2 = _encode_g2([sig, hash_to_g2(b"\x78" * 32)])
+        assert not bool(np.asarray(k.pairing_check_batch(px, py, qx2, qy2)))
+    finally:
+        bi.set_mxu_mode(0)

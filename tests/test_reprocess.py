@@ -75,6 +75,26 @@ def test_bucket_bound():
     assert q.parked == 4
 
 
+def test_by_root_global_cap():
+    """Random-root gossip (pre-signature-check) can't open unbounded
+    buckets: total by-root parks are globally capped, new parks refused
+    at the cap, and the budget is returned on replay AND expiry."""
+    q = ReprocessQueue(lambda w: None)
+    q.max_by_root_total = 8
+    for i in range(20):
+        q.park_until_block(bytes([i]) * 32, i, current_slot=0)
+    assert q.parked == 8 and q.refused_total == 12
+    # replay frees budget
+    assert q.on_block_imported(bytes([3]) * 32) == 1
+    q.park_until_block(b"z" * 32, "late", current_slot=0)
+    assert q.parked == 8
+    # expiry frees budget too
+    q.on_slot(1 + ReprocessQueue.EXPIRY_SLOTS)
+    assert q.parked == 0
+    q.park_until_block(b"y" * 32, "fresh", current_slot=70)
+    assert q.parked == 1
+
+
 # ---------------------------------------------------------------------------
 # end-to-end through chain + processor
 # ---------------------------------------------------------------------------

@@ -373,6 +373,36 @@ def test_backfill_bad_link_penalizes_peer():
     assert retry_peer and retry_peer[0] == "p2"
 
 
+def test_backfill_truncated_lower_edge_rewindows():
+    """ADVICE r4: a peer that truncates the LOWER edge of its window still
+    hash-links and advances the anchor; the gap then surfaces as a link
+    mismatch in the NEXT batch.  The machine must attribute the fault to
+    the truncating peer and re-download from the stored anchor (where the
+    missing blocks actually live) instead of burning the next batch."""
+    ctx = FakeCtx(spe=8)
+    blocks, _ = linked_history(33)
+    ctx.anchor = (32, blocks[31].root)
+    bf = BackfillSync(ctx)                     # 16-slot windows
+    bf.drive(["p1", "p2"])
+    (rid0, peer0, _, _), (rid1, peer1, _, _) = ctx.sent[:2]
+    # p1 serves [16,32) but truncates the bottom 4 slots
+    bf.on_range_response(rid0, blocks[20:32])
+    assert ctx.anchor == (20, blocks[19].root)
+    # p2's honest [0,16) now can't link (its top parent is in [16,20));
+    # blame is ambiguous, so BOTH peers are penalized (range_sync-style)
+    bf.on_range_response(rid1, blocks[0:16])
+    assert (peer0, "truncated_batch") in ctx.penalties
+    assert (peer1, "bad_segment") in ctx.penalties
+    # the machine re-windows from the anchor and completes with honest serves
+    bf.drive(["p2", "p3"])
+    new = [(rid, s, c) for rid, _p, s, c in ctx.sent[2:]]
+    assert new and new[0][1:] == (4, 16)       # window [4, 20) re-covers gap
+    bf.on_range_response(ctx.sent[2][0], blocks[4:20])
+    bf.drive(["p2", "p3"])
+    bf.on_range_response(ctx.sent[3][0], blocks[0:4])
+    assert bf.complete and ctx.anchor[0] == 0
+
+
 def test_backfill_partial_batch_links_and_continues():
     """A window where only some slots have blocks still links correctly."""
     ctx = FakeCtx(spe=8)
