@@ -69,6 +69,8 @@ class ReprocessQueue:
                 bucket.append(work)
                 self.parked_total += 1
                 self._by_root_count += 1
+            else:
+                self.refused_total += 1       # full bucket: drop, visibly
             self._by_root[block_root] = (parked_at, bucket)
 
     def on_slot(self, slot: int) -> int:

@@ -6,9 +6,13 @@ Independence notes (what keeps these vectors from being pure self-echo):
 - bls vectors are produced by the native C++ backend (an independent
   implementation, itself pinned to RFC 9380 constants), and consumed by
   the python oracle in the runner.
-- operations/epoch/sanity/fork_choice post-states come from this
-  implementation (regression pins; replaced by the real EF tarballs when
-  network access allows).
+- operations/epoch/sanity/finality post-states are verified at
+  GENERATION time against the independent scalar spec transcriptions
+  (scalar_spec.py for altair, scalar_spec_electra.py for capella/electra
+  — gen_corpus_r3.py / gen_corpus_r5.py), so a vectorized-STF bug fails
+  generation instead of being enshrined; fork_choice steps encode
+  hand-specified behavioral expectations.  The real EF tarballs would
+  still widen case coverage when network access allows.
 
 Run: python -m lighthouse_tpu.ef_tests.gen_corpus [dest_root]
 """
@@ -663,8 +667,10 @@ def main(dest: str | None = None, only: list[str] | None = None) -> None:
     dest_root = Path(dest or Path(__file__).resolve().parents[2]
                      / "tests" / "ef_vectors" / "tests")
     from .gen_corpus_r3 import generate_all
+    from .gen_corpus_r5 import generate_all as generate_r5
     if only:
         n = generate_all(dest_root, only)
+        n += generate_r5(dest_root, only)
         print(f"wrote {n} cases (partial: {only}) under {dest_root}")
         return
     if dest_root.exists():
@@ -677,6 +683,7 @@ def main(dest: str | None = None, only: list[str] | None = None) -> None:
     n += gen_kzg(dest_root)
     n += gen_transition(dest_root)
     n += generate_all(dest_root)
+    n += generate_r5(dest_root)
     print(f"wrote {n} cases under {dest_root}")
 
 

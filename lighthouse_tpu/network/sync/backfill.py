@@ -135,8 +135,18 @@ class BackfillSync:
                     # gets re-downloaded.
                     self.ctx.penalize(self._advanced_by[1],
                                       "truncated_batch")
-                    if batch.peer != self._advanced_by[1]:
-                        self.ctx.penalize(batch.peer, "bad_segment")
+                    # intermediate batches that claimed EMPTY windows are
+                    # equally suspect (a falsely-empty claim produces the
+                    # same signature); penalize every peer in the
+                    # ambiguous span so a liar can't hide behind honest
+                    # neighbours
+                    blamed = {self._advanced_by[1]}
+                    for mid in range(self._advanced_by[0] + 1, batch.id + 1):
+                        b = self.batches.get(mid)
+                        if b is not None and b.peer is not None \
+                                and b.peer not in blamed:
+                            blamed.add(b.peer)
+                            self.ctx.penalize(b.peer, "bad_segment")
                     self._rewindow()
                     return
                 self.ctx.penalize(batch.peer, "bad_segment")
@@ -174,6 +184,10 @@ class BackfillSync:
         self.process_ptr = self.next_batch_id
         self._req_end = anchor[0] if anchor else None
         self._rewindowed = True
+        # the re-downloaded span re-serves the same legitimately-empty
+        # windows; counting them twice could falsely trip
+        # MAX_EMPTY_WINDOWS and stop an honest backfill
+        self.empty_windows = 0
 
     @property
     def in_flight(self) -> int:

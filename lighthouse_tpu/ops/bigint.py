@@ -56,7 +56,15 @@ def mxu_mode() -> int:
 
 
 def set_mxu_mode(mode: int) -> None:
-    """Switch the multiply lowering (0/1/2) and invalidate jit traces."""
+    """Switch the multiply lowering (0/1/2) and invalidate jit traces.
+
+    The global clear is deliberate: every jitted PIPELINE program
+    (Miller loop, hash-to-G2, ...) traces THROUGH mont_mul, so its cache
+    key cannot see the mode — per-mode mont_mul entry points would leave
+    those outer traces stale on the old lowering.  Switching modes is a
+    bench/test operation; production picks one mode per process via the
+    env var.
+    """
     global _MXU_MODE
     mode = int(mode)
     if mode not in (0, 1, 2):

@@ -191,7 +191,6 @@ def test_mxu_digit_modes_through_curve_ops(mode):
     """The LHTPU_BIGINT_MXU digit lowerings push exactly through the tower
     and curve layers (fp2 mul/inv, G1 scalar mul) — small programs, always
     run; the full pairing under mode 1 is the gated slow test below."""
-    import os
     a = rand_fp2(4)
     b = rand_fp2(4)
     try:
