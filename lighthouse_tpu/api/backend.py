@@ -362,20 +362,39 @@ class ApiBackend:
 
     def attestation_data(self, slot: int, committee_index: int):
         chain = self.chain
-        # fast path: the early-attester cache serves the current head
+        # fast path 1: the early-attester cache serves the current head
         # state-free (early_attester_cache.rs:1-30)
         early = chain.early_attester_cache.try_attest(chain, slot,
                                                       committee_index)
         if early is not None:
             return early
+        # fast path 2: non-head slots whose epoch is decided — source
+        # checkpoint from the attester cache, roots from fork choice; no
+        # state read or replay (attester_cache.rs:1-60)
+        cached = chain.attester_cache.attestation_data(chain, slot,
+                                                       committee_index)
+        if cached is not None:
+            return cached
         head = chain.head()
         st = head.head_state
         if st.slot < slot:
             st = st.copy()
             process_slots(st, slot)
+            # prime the attester cache: this (epoch, chain) replays once
+            chain.attester_cache.cache_state(chain, st)
         T = chain.T
         spe = chain.spec.preset.slots_per_epoch
         epoch = compute_epoch_at_slot(slot, spe)
+        head_epoch = st.current_epoch()
+        # the source an epoch-E attestation needs is the checkpoint that
+        # was *current during E*; from a later head state that is only
+        # derivable one epoch back (r5 review)
+        if epoch == head_epoch:
+            source = st.current_justified_checkpoint
+        elif epoch == head_epoch - 1:
+            source = st.previous_justified_checkpoint
+        else:
+            raise ApiError(400, "attestation slot too old to produce")
         epoch_start = compute_start_slot_at_epoch(epoch, spe)
         if head.head_state.slot <= epoch_start:
             target_root = head.head_block_root
@@ -384,7 +403,7 @@ class ApiBackend:
         return T.AttestationData(
             slot=slot, index=committee_index,
             beacon_block_root=head.head_block_root,
-            source=st.current_justified_checkpoint,
+            source=source,
             target=T.Checkpoint(epoch=epoch, root=target_root))
 
     def publish_attestation(self, attestation) -> None:

@@ -113,12 +113,14 @@ class BeaconChain:
         self._snapshots: OrderedDict[bytes, BeaconState] = OrderedDict()
         self._snapshots[self.genesis_block_root] = genesis_state
         from .hot_caches import (
-            EarlyAttesterCache, PreFinalizationCache, ProposerCache,
-            ShufflingCache,
+            AttesterCache, EarlyAttesterCache, Eth1FinalizationCache,
+            PreFinalizationCache, ProposerCache, ShufflingCache,
         )
         self.shuffling_cache = ShufflingCache()
         self.proposer_cache = ProposerCache()
         self.early_attester_cache = EarlyAttesterCache()
+        self.attester_cache = AttesterCache()
+        self.eth1_finalization_cache = Eth1FinalizationCache()
         self.pre_finalization_cache = PreFinalizationCache()
         self._advanced: tuple[bytes, BeaconState] | None = None
         # set by the network service when a BeaconProcessor is attached;
@@ -461,8 +463,10 @@ class BeaconChain:
             self._cache_snapshot(block_root, state)
             try:
                 # serve attestations for this block state-free from now on
-                # (early_attester_cache.rs:1-30)
+                # (early_attester_cache.rs:1-30, attester_cache.rs:1-60)
                 self.early_attester_cache.add(self, block_root, block, state)
+                self.attester_cache.cache_state(self, state)
+                self.eth1_finalization_cache.insert(state, block_root)
             except Exception:               # pragma: no cover - advisory
                 pass
         self.events.emit("block", {"slot": block.slot,
@@ -644,6 +648,15 @@ class BeaconChain:
         self.block_times = {r: t for r, t in self.block_times.items()
                             if t.get("slot", 0) > fin_slot}
         self.fork_choice.prune()
+        # eth1 deposit-tracker pruning from the cached boundary snapshot
+        # (eth1_finalization_cache.rs): no state read at finalization time
+        eth1_snap = self.eth1_finalization_cache.finalize(fin_epoch,
+                                                          fin_root)
+        if eth1_snap is not None and self.eth1_service is not None:
+            try:
+                self.eth1_service.finalize(eth1_snap)
+            except Exception:               # pragma: no cover - advisory
+                pass
         self.events.emit("finalized_checkpoint",
                          {"epoch": fin_epoch, "root": fin_root})
         # migrate finalized data to the freezer

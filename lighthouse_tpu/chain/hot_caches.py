@@ -5,8 +5,8 @@ Reference analogs (VERDICT r3 "next" #4):
 - ShufflingCache        beacon_node/beacon_chain/src/shuffling_cache.rs:1-40
 - BeaconProposerCache   beacon_node/beacon_chain/src/beacon_proposer_cache.rs
 - EarlyAttesterCache    beacon_node/beacon_chain/src/early_attester_cache.rs:1-30
-- AttesterCache         beacon_node/beacon_chain/src/attester_cache.rs
-                        (folded into ShufflingCache + EarlyAttesterCache here)
+- AttesterCache         beacon_node/beacon_chain/src/attester_cache.rs:1-60
+- Eth1FinalizationCache beacon_node/beacon_chain/src/eth1_finalization_cache.rs
 - PreFinalizationCache  beacon_node/beacon_chain/src/pre_finalization_cache.rs
 - StateAdvanceTimer     beacon_node/beacon_chain/src/state_advance_timer.rs:1-15
                         (the per-slot hook lives in BeaconChain.per_slot_task)
@@ -201,6 +201,126 @@ class EarlyAttesterCache:
             target=T.Checkpoint(epoch=e.target[0], root=e.target[1]))
 
 
+class AttesterCache:
+    """Serve attestation data for a slot whose epoch is already decided on
+    the head chain WITHOUT any state read or replay
+    (beacon_chain/src/attester_cache.rs:1-60).
+
+    The only state-derived field of AttestationData is the source
+    (justified) checkpoint, which is fixed per (epoch, decision_root)
+    where decision_root is the head-chain block root at the last slot of
+    the previous epoch; beacon_block_root and the target root come from
+    fork choice (proto-array ancestor walk).  Primed at block import and
+    by the state-advance timer; the state fallback path also primes it so
+    a given (epoch, chain) replays at most once.
+    """
+
+    SIZE = 16
+
+    def __init__(self):
+        self._map: OrderedDict[tuple[int, bytes], tuple[int, bytes]] = \
+            OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _decision_slot(epoch: int, spe: int) -> int:
+        return max(compute_start_slot_at_epoch(epoch, spe) - 1, 0)
+
+    def cache_state(self, chain, state) -> None:
+        """Record the justified checkpoint a state carries for its own
+        epoch (call with any state advanced into the epoch)."""
+        spe = state.slots_per_epoch
+        epoch = state.current_epoch()
+        dslot = self._decision_slot(epoch, spe)
+        try:
+            droot = state.get_block_root_at_slot(dslot)
+        except Exception:
+            return                      # state too young for the lookup
+        value = (int(state.current_justified_checkpoint.epoch),
+                 bytes(state.current_justified_checkpoint.root))
+        with self._lock:
+            self._map[(epoch, droot)] = value
+            self._map.move_to_end((epoch, droot))
+            while len(self._map) > self.SIZE:
+                self._map.popitem(last=False)
+
+    def attestation_data(self, chain, slot: int, committee_index: int):
+        """AttestationData from caches + fork choice only; None -> the
+        caller must fall back to a state (and should prime us)."""
+        spe = chain.spec.preset.slots_per_epoch
+        epoch = compute_epoch_at_slot(slot, spe)
+        head = chain.head()
+        head_root = head.head_block_root
+        pa = chain.fork_choice.proto_array
+        droot = pa.ancestor_at_or_below_slot(
+            head_root, self._decision_slot(epoch, spe))
+        if droot is None:
+            return None
+        with self._lock:
+            value = self._map.get((epoch, droot))
+        if value is None:
+            return None
+        target_root = pa.ancestor_at_or_below_slot(
+            head_root, compute_start_slot_at_epoch(epoch, spe))
+        if target_root is None:
+            return None
+        T = chain.T
+        return T.AttestationData(
+            slot=slot, index=committee_index,
+            beacon_block_root=head_root,
+            source=T.Checkpoint(epoch=value[0], root=value[1]),
+            target=T.Checkpoint(epoch=epoch, root=target_root))
+
+
+class Eth1FinalizationCache:
+    """Eth1Data snapshots at epoch-boundary states, keyed by checkpoint
+    (beacon_chain/src/eth1_finalization_cache.rs): when a checkpoint
+    finalizes, the snapshot tells the eth1 deposit tracker how far its
+    block/deposit caches can prune without waiting for a state read."""
+
+    SIZE = 64
+
+    def __init__(self):
+        # (epoch, checkpoint_root) -> (deposit_root, count, deposit_index)
+        self._map: OrderedDict[tuple[int, bytes], tuple] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def insert(self, state, block_root: bytes) -> None:
+        """Record the snapshot ONLY from a block sitting at its epoch's
+        start slot: that block IS the checkpoint root for the epoch, so
+        its post-state deposit counters are exactly what finalizing the
+        checkpoint finalizes.  A later block's state would include
+        deposits that can still reorg after the checkpoint finalizes,
+        and would be keyed by a root that never equals the checkpoint
+        root (the fork check would permanently miss — r5 review)."""
+        epoch = state.current_epoch()
+        spe = state.slots_per_epoch
+        if int(state.latest_block_header.slot) != \
+                compute_start_slot_at_epoch(epoch, spe):
+            return
+        key = (epoch, block_root)
+        snap = (bytes(state.eth1_data.deposit_root),
+                int(state.eth1_data.deposit_count),
+                int(state.eth1_deposit_index))
+        with self._lock:
+            self._map[key] = snap
+            self._map.move_to_end(key)
+            while len(self._map) > self.SIZE:
+                self._map.popitem(last=False)
+
+    def finalize(self, epoch: int, block_root: bytes):
+        """Snapshot for the finalized checkpoint (or None) — drops all
+        entries at/below its epoch either way."""
+        with self._lock:
+            snap = self._map.get((epoch, block_root))
+            for k in [k for k in self._map if k[0] <= epoch]:
+                del self._map[k]
+        if snap is None:
+            return None
+        return {"deposit_root": snap[0], "deposit_count": snap[1],
+                "deposit_index": snap[2]}
+
+
 class PreFinalizationCache:
     """Bounded set of block roots proven to be pre-finalization garbage
     (pre_finalization_cache.rs): gossip referencing them is rejected
@@ -255,4 +375,7 @@ def state_advance(chain, current_slot: int) -> bool:
     # lands at/after the boundary)
     chain.shuffling_cache.insert(head_root, next_epoch,
                                  committee_cache(state, next_epoch))
+    # the advanced state carries next epoch's justified checkpoint: prime
+    # the attester cache so boundary attestation requests skip the state
+    chain.attester_cache.cache_state(chain, state)
     return True

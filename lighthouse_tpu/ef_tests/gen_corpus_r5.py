@@ -13,8 +13,6 @@ capella::process_withdrawals, per_epoch_processing/single_pass.rs.
 """
 from __future__ import annotations
 
-import hashlib
-
 from . import scalar_spec_electra as sse
 from .gen_corpus import _write_state, w_ssz, wcase
 

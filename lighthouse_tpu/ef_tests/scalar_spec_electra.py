@@ -45,7 +45,7 @@ MIN_VALIDATOR_WITHDRAWABILITY_DELAY = 256
 SHARD_COMMITTEE_PERIOD = 64
 MAX_WITHDRAWALS_PER_PAYLOAD = 4          # minimal
 MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP = 16
-MAX_PENDING_PARTIALS_PER_SWEEP = 8
+MAX_PENDING_PARTIALS_PER_SWEEP = 2     # minimal (mainnet: 8)
 MAX_PENDING_DEPOSITS_PER_EPOCH = 16
 PENDING_PARTIAL_WITHDRAWALS_LIMIT = 64
 PENDING_CONSOLIDATIONS_LIMIT = 64

@@ -77,7 +77,31 @@ class Eth1Service:
         self.deposit_tree = MerkleTree(DEPOSIT_CONTRACT_TREE_DEPTH)
         self.deposit_logs: list[DepositLog] = []
         self._proof_trees: dict[int, MerkleTree] = {}  # deposit_count -> tree
+        self.finalized_deposit_count = 0
         self._lock = threading.Lock()
+
+    # -- finalization pruning (eth1_finalization_cache.rs consumer) ----------
+
+    def finalize(self, snap: dict) -> None:
+        """Prune tracker caches below a finalized checkpoint's eth1
+        snapshot: deposits at indices below the finalized deposit_index
+        can never be requested again (every future state's
+        eth1_deposit_index is >= it), so their cached proof trees and the
+        eth1 blocks at/below the finalized deposit_count go."""
+        with self._lock:
+            count = int(snap["deposit_index"])
+            if count <= self.finalized_deposit_count:
+                return
+            self.finalized_deposit_count = count
+            for k in [k for k in self._proof_trees if k < count]:
+                del self._proof_trees[k]
+            keep_from = 0
+            for i, b in enumerate(self.block_cache):
+                if b.deposit_count <= int(snap["deposit_count"]):
+                    keep_from = i
+            # keep the newest pre-finalization block (votes may reference
+            # it) and everything after
+            self.block_cache = self.block_cache[keep_from:]
 
     # -- polling (service.rs update loop) ------------------------------------
 

@@ -141,6 +141,7 @@ MINIMAL_PRESET = Preset(
     pending_consolidations_limit=64,
     max_deposit_requests_per_payload=4,
     max_withdrawal_requests_per_payload=2,
+    max_pending_partials_per_withdrawals_sweep=2,
 )
 
 # Gnosis (consensus/types/src/eth_spec.rs:520-580 GnosisEthSpec):

@@ -203,3 +203,94 @@ def test_cache_lru_bounds():
     assert len(pf._roots) == PreFinalizationCache.SIZE
     assert pf.contains((PreFinalizationCache.SIZE + 9).to_bytes(32, "big"))
     assert not pf.contains((0).to_bytes(32, "big"))
+
+
+def test_attester_cache_serves_next_epoch_without_replay(harness,
+                                                         monkeypatch):
+    """THE done-criterion (attester_cache.rs): attestation data for a
+    slot in an epoch the head state hasn't reached — where the early
+    cache misses (different epoch) — is served from the attester cache
+    with ZERO state replay once the state-advance timer primed it."""
+    h = harness
+    spe = h.chain.spec.preset.slots_per_epoch
+    h.extend_chain(spe - 2, attest=False)
+    h.set_slot(spe - 1)                   # timer primes epoch 1
+    from lighthouse_tpu.api.backend import ApiBackend
+    api = ApiBackend(h.chain)
+    counter = {"n": 0}
+    import lighthouse_tpu.api.backend as backend_mod
+    _patch_replay_counter(monkeypatch, backend_mod, counter)
+    h.set_slot(spe)                       # epoch 1, no block yet
+    data = api.attestation_data(spe, 0)
+    assert counter["n"] == 0, "attester cache path must not replay"
+    assert data.beacon_block_root == h.chain.head().head_block_root
+    assert data.target.epoch == 1
+    # agreement with the state-backed slow path
+    h.chain.attester_cache._map.clear()
+    h.chain.early_attester_cache._entry = None
+    slow = api.attestation_data(spe, 0)
+    assert (slow.source.epoch, bytes(slow.source.root)) == \
+        (data.source.epoch, bytes(data.source.root))
+    assert bytes(slow.target.root) == bytes(data.target.root)
+    assert counter["n"] >= 1              # the fallback replayed...
+    # ...and primed the cache: the next request is replay-free again
+    before = counter["n"]
+    again = api.attestation_data(spe, 1)
+    assert counter["n"] == before
+    assert bytes(again.source.root) == bytes(data.source.root)
+
+
+def test_eth1_finalization_cache_snapshot_and_prune(harness):
+    """eth1_finalization_cache.rs: the finalized checkpoint's eth1
+    snapshot is served from the cache (fork-checked), entries at/below
+    it drop, and the eth1 tracker prunes its proof/block caches."""
+    from lighthouse_tpu.chain.hot_caches import Eth1FinalizationCache
+    from lighthouse_tpu.eth1 import Eth1Service, MockEth1Endpoint
+    h = harness
+    st = h.chain.head().head_state
+    c = Eth1FinalizationCache()
+    c.insert(st, b"r0" * 16)
+    snap = c.finalize(st.current_epoch(), b"r0" * 16)
+    assert snap is not None
+    assert snap["deposit_count"] == int(st.eth1_data.deposit_count)
+    assert snap["deposit_index"] == int(st.eth1_deposit_index)
+    # entries at/below the finalized epoch are gone
+    assert c.finalize(st.current_epoch(), b"r0" * 16) is None
+    # wrong fork root -> no snapshot
+    c.insert(st, b"r1" * 16)
+    assert c.finalize(st.current_epoch(), b"XX" * 16) is None
+
+    # chain integration: only a block AT the epoch boundary slot primes
+    # the cache, keyed by the checkpoint (epoch, root) it will finalize as
+    spe = h.chain.spec.preset.slots_per_epoch
+    h.extend_chain(spe + 1, attest=False)     # crosses the epoch-1 boundary
+    head_state = h.chain.head().head_state
+    boundary_root = head_state.get_block_root_at_slot(spe)
+    snap = h.chain.eth1_finalization_cache.finalize(1, boundary_root)
+    assert snap is not None
+    # a non-checkpoint root from the same epoch misses
+    h.chain.eth1_finalization_cache.insert(head_state,
+                                           h.chain.head().head_block_root)
+    assert h.chain.eth1_finalization_cache.finalize(
+        1, h.chain.head().head_block_root) is None
+
+    spec = h.chain.spec
+    endpoint = MockEth1Endpoint(spec, h.chain.T)
+    svc = Eth1Service(spec, h.chain.T, endpoint)
+    for _ in range(20 + spec.eth1_follow_distance):
+        endpoint.add_block()
+    svc.update()
+    svc._proof_trees = {4: object(), 8: object(), 16: object()}
+    n_blocks = len(svc.block_cache)
+    assert n_blocks > 2
+    mid = svc.block_cache[len(svc.block_cache) // 2]
+    svc.finalize({"deposit_root": b"\x00" * 32,
+                  "deposit_count": mid.deposit_count or 0,
+                  "deposit_index": 8})
+    assert svc.finalized_deposit_count == 8
+    assert sorted(svc._proof_trees) == [8, 16]
+    assert len(svc.block_cache) <= n_blocks
+    # monotonic: an older snapshot is a no-op
+    svc.finalize({"deposit_root": b"\x00" * 32, "deposit_count": 0,
+                  "deposit_index": 2})
+    assert svc.finalized_deposit_count == 8
