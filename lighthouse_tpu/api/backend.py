@@ -400,9 +400,16 @@ class ApiBackend:
             target_root = head.head_block_root
         else:
             target_root = st.get_block_root_at_slot(epoch_start)
+        # vote the head-chain block AT/BELOW the request slot: the head
+        # itself is "newer than slot" for past slots and fork choice
+        # rejects such votes (r5 review)
+        if head.head_state.slot <= slot:
+            block_root = head.head_block_root
+        else:
+            block_root = st.get_block_root_at_slot(slot)
         return T.AttestationData(
             slot=slot, index=committee_index,
-            beacon_block_root=head.head_block_root,
+            beacon_block_root=block_root,
             source=source,
             target=T.Checkpoint(epoch=epoch, root=target_root))
 

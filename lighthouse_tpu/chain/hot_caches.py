@@ -250,6 +250,10 @@ class AttesterCache:
         spe = chain.spec.preset.slots_per_epoch
         epoch = compute_epoch_at_slot(slot, spe)
         head = chain.head()
+        # same staleness bound as the state fallback (which 400s): the
+        # answer must not depend on LRU residency (r5 review)
+        if epoch < head.head_state.current_epoch() - 1:
+            return None
         head_root = head.head_block_root
         pa = chain.fork_choice.proto_array
         droot = pa.ancestor_at_or_below_slot(
@@ -260,14 +264,18 @@ class AttesterCache:
             value = self._map.get((epoch, droot))
         if value is None:
             return None
+        # the LMD vote for slot S is the head-chain block AT/BELOW S —
+        # voting the head itself for a past slot is rejected by fork
+        # choice ("attestation for block newer than slot")
+        block_root = pa.ancestor_at_or_below_slot(head_root, slot)
         target_root = pa.ancestor_at_or_below_slot(
             head_root, compute_start_slot_at_epoch(epoch, spe))
-        if target_root is None:
+        if block_root is None or target_root is None:
             return None
         T = chain.T
         return T.AttestationData(
             slot=slot, index=committee_index,
-            beacon_block_root=head_root,
+            beacon_block_root=block_root,
             source=T.Checkpoint(epoch=value[0], root=value[1]),
             target=T.Checkpoint(epoch=epoch, root=target_root))
 
@@ -298,7 +306,25 @@ class Eth1FinalizationCache:
         if int(state.latest_block_header.slot) != \
                 compute_start_slot_at_epoch(epoch, spe):
             return
-        key = (epoch, block_root)
+        self._put((epoch, block_root), state)
+
+    def insert_boundary(self, state) -> None:
+        """Prime from a state ADVANCED through an empty epoch boundary
+        (state_advance): the checkpoint root for the new epoch is then
+        the last block before the boundary, whose post-state deposit
+        counters this state still carries (deposits only change in
+        blocks).  If a block later lands ON the boundary slot, the
+        import-path insert records the real checkpoint under its own
+        key and this entry is simply never looked up."""
+        epoch = state.current_epoch()
+        spe = state.slots_per_epoch
+        start = compute_start_slot_at_epoch(epoch, spe)
+        if int(state.slot) != start or \
+                int(state.latest_block_header.slot) >= start:
+            return
+        self._put((epoch, state.get_block_root_at_slot(start - 1)), state)
+
+    def _put(self, key, state) -> None:
         snap = (bytes(state.eth1_data.deposit_root),
                 int(state.eth1_data.deposit_count),
                 int(state.eth1_deposit_index))
@@ -378,4 +404,6 @@ def state_advance(chain, current_slot: int) -> bool:
     # the advanced state carries next epoch's justified checkpoint: prime
     # the attester cache so boundary attestation requests skip the state
     chain.attester_cache.cache_state(chain, state)
+    # and the eth1 snapshot for an empty-boundary checkpoint
+    chain.eth1_finalization_cache.insert_boundary(state)
     return True

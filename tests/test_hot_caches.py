@@ -294,3 +294,45 @@ def test_eth1_finalization_cache_snapshot_and_prune(harness):
     svc.finalize({"deposit_root": b"\x00" * 32, "deposit_count": 0,
                   "deposit_index": 2})
     assert svc.finalized_deposit_count == 8
+
+
+def test_attestation_data_past_slot_votes_ancestor(harness):
+    """An attestation produced for a PAST slot must vote the head-chain
+    block at/below that slot — voting the newer head is rejected by fork
+    choice ("attestation for block newer than slot")."""
+    h = harness
+    h.extend_chain(5, attest=False)
+    from lighthouse_tpu.api.backend import ApiBackend
+    api = ApiBackend(h.chain)
+    head_state = h.chain.head().head_state
+    past = int(head_state.slot) - 2
+    want_root = head_state.get_block_root_at_slot(past)
+    # cache path
+    data = h.chain.attester_cache.attestation_data(h.chain, past, 0)
+    if data is not None:
+        assert bytes(data.beacon_block_root) == want_root
+    # slow path
+    h.chain.attester_cache._map.clear()
+    h.chain.early_attester_cache._entry = None
+    slow = api.attestation_data(past, 0)
+    assert bytes(slow.beacon_block_root) == want_root
+    # both are acceptable fork-choice votes
+    from lighthouse_tpu.fork_choice.fork_choice import ForkChoiceError
+    node = h.chain.fork_choice.proto_array.get(want_root)
+    assert node.slot <= past
+
+
+def test_eth1_finalization_cache_empty_boundary_primed(harness):
+    """When the epoch-boundary slot is empty, the state-advance timer
+    primes the snapshot under the checkpoint root the epoch will
+    actually finalize as (the last pre-boundary block)."""
+    h = harness
+    spe = h.chain.spec.preset.slots_per_epoch
+    h.extend_chain(spe - 2, attest=False)     # last block at slot spe-2
+    last_root = h.chain.head().head_block_root
+    h.set_slot(spe - 1)                       # timer advances through
+    # the boundary slot spe is empty: checkpoint root for epoch 1 = the
+    # pre-boundary block
+    snap = h.chain.eth1_finalization_cache.finalize(1, last_root)
+    assert snap is not None
+    assert snap["deposit_index"] == 64
