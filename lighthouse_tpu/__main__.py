@@ -12,7 +12,7 @@ import json
 import sys
 
 
-def main(argv=None):
+def build_parser():
     parser = argparse.ArgumentParser(
         prog="lighthouse_tpu",
         description="TPU-native Ethereum consensus client")
@@ -29,8 +29,31 @@ def main(argv=None):
     bn = sub.add_parser("beacon_node", aliases=["bn", "beacon"])
     bn.add_argument("--datadir", default=None)
     bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--disable-http", action="store_true",
+                    help="do not start the HTTP API server")
     bn.add_argument("--metrics", action="store_true")
     bn.add_argument("--metrics-port", type=int, default=5054)
+    bn.add_argument("--listen-address", default="127.0.0.1",
+                    help="libp2p + discovery listen address")
+    bn.add_argument("--target-peers", type=int, default=16)
+    bn.add_argument("--discovery-port", type=int, default=0,
+                    help="discv5 UDP port (0 = ephemeral)")
+    bn.add_argument("--upnp", action="store_true",
+                    help="attempt UPnP port mapping at startup")
+    bn.add_argument("--subscribe-all-subnets", action="store_true",
+                    help="advertise + subscribe every attestation subnet")
+    bn.add_argument("--graffiti", default="",
+                    help="ascii graffiti for locally produced blocks")
+    bn.add_argument("--suggested-fee-recipient", default=None,
+                    help="0x-prefixed 20-byte default fee recipient")
+    bn.add_argument("--snapshot-cache-size", type=int, default=8)
+    bn.add_argument("--reorg-threshold", type=int, default=20,
+                    help="late-block re-org weight threshold (percent)")
+    bn.add_argument("--disable-light-client-server", action="store_true")
+    bn.add_argument("--validator-monitor-pubkeys", default="",
+                    help="comma-separated 0x pubkeys to monitor")
+    bn.add_argument("--purge-db", action="store_true",
+                    help="wipe the datadir's chain database on startup")
     bn.add_argument("--port", type=int, default=9000,
                     help="p2p listen port")
     bn.add_argument("--boot-nodes", default="",
@@ -128,7 +151,11 @@ def main(argv=None):
     vm_move.add_argument("--password", default="lighthouse-tpu")
     vm_move.add_argument("--pubkeys", required=True,
                          help="comma-separated 0x pubkeys")
+    return parser
 
+
+def main(argv=None):
+    parser = build_parser()
     args = parser.parse_args(argv)
 
     if args.testnet_dir:
@@ -274,10 +301,38 @@ def _run_beacon_node(spec, args):
     for hp in filter(None, args.boot_nodes.split(",")):
         host, _, port = hp.rpartition(":")
         boot.append((host or "127.0.0.1", int(port)))
+    graffiti = args.graffiti.encode()[:32].ljust(32, b"\x00") \
+        if args.graffiti else None
+    fee_recipient = None
+    if args.suggested_fee_recipient:
+        try:
+            fee_recipient = bytes.fromhex(
+                args.suggested_fee_recipient.removeprefix("0x"))
+        except ValueError:
+            fee_recipient = b""
+        if len(fee_recipient) != 20:
+            print("error: --suggested-fee-recipient must be a 0x-prefixed"
+                  " 20-byte hex address", file=sys.stderr)
+            return 2
+    monitor_pubkeys = [bytes.fromhex(p.strip().removeprefix("0x"))
+                       for p in args.validator_monitor_pubkeys.split(",")
+                       if p.strip()]
     cfg = ClientConfig(
         datadir=args.datadir, http_port=args.http_port,
+        http_enabled=not args.disable_http,
         metrics_enabled=args.metrics, metrics_port=args.metrics_port,
-        network=NetworkConfig(port=args.port, boot_nodes=boot),
+        network=NetworkConfig(
+            host=args.listen_address, port=args.port,
+            target_peers=args.target_peers, boot_nodes=boot,
+            upnp_enabled=args.upnp,
+            subscribe_all_subnets=args.subscribe_all_subnets),
+        discovery_port=args.discovery_port,
+        graffiti=graffiti, suggested_fee_recipient=fee_recipient,
+        snapshot_cache_size=args.snapshot_cache_size,
+        reorg_threshold_pct=args.reorg_threshold,
+        light_client_server=not args.disable_light_client_server,
+        validator_monitor_pubkeys=monitor_pubkeys,
+        purge_db=args.purge_db,
         slasher_enabled=args.slasher, crypto_backend=args.crypto_backend,
         interop_validator_count=args.interop_validators,
         genesis_time=args.genesis_time)
@@ -299,6 +354,8 @@ def _run_beacon_node(spec, args):
         for k, v in out.items():
             if isinstance(v, bytes):
                 out[k] = "0x" + v.hex()
+            elif isinstance(v, list) and v and isinstance(v[0], bytes):
+                out[k] = ["0x" + b.hex() for b in v]
         print(json.dumps(out, default=str))
         return 0
     env = Environment(args.log_level)

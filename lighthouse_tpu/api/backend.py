@@ -357,7 +357,7 @@ class ApiBackend:
     def produce_block(self, slot: int, randao_reveal: bytes,
                       graffiti: bytes | None = None):
         block, _post = self.chain.produce_block(
-            randao_reveal, slot, graffiti=graffiti or b"\x00" * 32)
+            randao_reveal, slot, graffiti=graffiti)
         return block
 
     def attestation_data(self, slot: int, committee_index: int):
@@ -875,7 +875,7 @@ class ApiBackend:
                           graffiti: bytes | None = None) -> bytes:
         from ..ssz import serialize
         block, _post = self.chain.produce_block(
-            randao_reveal, slot, graffiti=graffiti or b"\x00" * 32)
+            randao_reveal, slot, graffiti=graffiti)
         return serialize(type(block).ssz_type, block)
 
     def produce_blinded_block_ssz(self, slot: int, randao_reveal: bytes,
@@ -886,7 +886,7 @@ class ApiBackend:
         from ..specs.chain_spec import ForkName
         from ..ssz import serialize
         block, _post = self.chain.produce_block(
-            randao_reveal, slot, graffiti=graffiti or b"\x00" * 32)
+            randao_reveal, slot, graffiti=graffiti)
         if type(block).fork_name < ForkName.BELLATRIX:
             return serialize(type(block).ssz_type, block)   # no payloads yet
         blinded = blind_block(self.chain.T, block)

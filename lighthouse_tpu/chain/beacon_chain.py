@@ -158,9 +158,13 @@ class BeaconChain:
         self.builder = None                    # BuilderHttpClient | None
         self.builder_boost_factor = 100        # percent
         self.default_fee_recipient = b"\x00" * 20
+        self.default_graffiti = b"\x00" * 32   # --graffiti flag
         self.block_production_log: list[dict] = []   # payload source audit
         from .validator_monitor import ValidatorMonitor
         self.validator_monitor = ValidatorMonitor(self)
+        # --validator-monitor-pubkeys not yet in the registry: re-resolved
+        # each slot so a later deposit still gets monitored (r5 review)
+        self.monitor_pubkeys_pending: list[bytes] = []
         self._monitored_epoch = 0
         self.eth1_service = None       # optional Eth1Service
 
@@ -700,6 +704,16 @@ class BeaconChain:
         slot = self.slot()
         with self._lock:
             self.fork_choice.update_time(slot)
+        if self.monitor_pubkeys_pending:
+            registry = self.head().head_state.validators
+            still = []
+            for pk in self.monitor_pubkeys_pending:
+                idx = registry.index_of(pk)
+                if idx is not None:
+                    self.validator_monitor.register_validator(idx)
+                else:
+                    still.append(pk)
+            self.monitor_pubkeys_pending = still
         from .hot_caches import state_advance
         try:
             state_advance(self, slot)
@@ -788,12 +802,14 @@ class BeaconChain:
     # -- block production ----------------------------------------------------
 
     def produce_block(self, randao_reveal: bytes, slot: int,
-                      graffiti: bytes = b"\x00" * 32,
+                      graffiti: bytes | None = None,
                       skip_randao_verification: bool = False,
                       sync_aggregate=None):
         """3-phase production (beacon_chain.rs:4810): (1) state advance +
         op-pool packing, (2) payload retrieval, (3) completion + state root.
         Returns (block, post_state)."""
+        if graffiti is None:
+            graffiti = self.default_graffiti
         parent_root = self.get_proposer_head(slot)
         with self._lock:
             head = self.canonical_head

@@ -39,6 +39,15 @@ class ClientConfig:
     interop_validator_count: int = 0
     genesis_time: int | None = None
     genesis_state: object | None = None     # testnet-dir genesis.ssz
+    # round-5 flag surface (beacon_node/src/cli.rs parity slice)
+    discovery_port: int = 0                 # discv5 UDP (0 = ephemeral)
+    graffiti: bytes | None = None           # 32B default block graffiti
+    suggested_fee_recipient: bytes | None = None   # 20B
+    snapshot_cache_size: int = 8
+    reorg_threshold_pct: int = 20
+    light_client_server: bool = True
+    validator_monitor_pubkeys: list = field(default_factory=list)
+    purge_db: bool = False
 
 
 class Client:
@@ -49,6 +58,7 @@ class Client:
         self.metrics_server: MetricsServer | None = None
         self.slasher: Slasher | None = None
         self.discovery = None
+        self.nat = None                 # NatOutcome when UPnP attempted
         self.env: Environment | None = None
 
     def stop(self) -> None:
@@ -57,6 +67,13 @@ class Client:
         if self.metrics_server:
             self.metrics_server.stop()
         if self.discovery:
+            if self.chain is not None:
+                try:
+                    # persist the routing table for a bootnode-free
+                    # restart (network/src/persisted_dht.rs)
+                    self.discovery.persist(self.chain.store)
+                except Exception:       # advisory: shutdown continues
+                    pass
             self.discovery.stop()   # owns a UDP socket + recv thread
         if self.network:
             self.network.stop()
@@ -81,6 +98,11 @@ class ClientBuilder:
         # store
         if cfg.datadir:
             os.makedirs(cfg.datadir, exist_ok=True)
+            if cfg.purge_db:
+                import shutil
+                for name in ("chain_db", "freezer_db"):
+                    shutil.rmtree(os.path.join(cfg.datadir, name),
+                                  ignore_errors=True)
             store = HotColdDB(
                 NativeKvStore(os.path.join(cfg.datadir, "chain_db")),
                 NativeKvStore(os.path.join(cfg.datadir, "freezer_db")),
@@ -89,7 +111,12 @@ class ClientBuilder:
             store = HotColdDB(MemoryStore(), MemoryStore(), self.spec)
 
         # beacon chain (resume / genesis / checkpoint sync)
-        cb = BeaconChainBuilder(self.spec).store(store)
+        from ..chain.beacon_chain import ChainConfig
+        cb = BeaconChainBuilder(self.spec).store(store).chain_config(
+            ChainConfig(
+                snapshot_cache_size=cfg.snapshot_cache_size,
+                reorg_threshold_pct=cfg.reorg_threshold_pct,
+                enable_light_client_server=cfg.light_client_server))
         resume_anchor = (store.anchor_state()
                          if cfg.datadir and cfg.checkpoint_sync_state is None
                          else None)
@@ -127,6 +154,22 @@ class ClientBuilder:
         # checkpoint-sync slot math — review finding)
         cb.execution_layer(MockExecutionLayer())
         client.chain = cb.build()
+        if cfg.graffiti is not None:
+            client.chain.default_graffiti = cfg.graffiti
+        if cfg.suggested_fee_recipient is not None:
+            client.chain.default_fee_recipient = cfg.suggested_fee_recipient
+        registry = client.chain.head().head_state.validators
+        for pk in cfg.validator_monitor_pubkeys:
+            idx = registry.index_of(pk)
+            if idx is not None:
+                client.chain.validator_monitor.register_validator(idx)
+            else:
+                # not in the registry yet (deposit pending / checkpoint
+                # sync): re-resolved each slot by per_slot_task
+                self.env.log.info(
+                    "validator-monitor pubkey %s not yet in registry; "
+                    "will watch for it", "0x" + pk.hex()[:16])
+                client.chain.monitor_pubkeys_pending.append(pk)
 
         # slasher
         if cfg.slasher_enabled:
@@ -140,10 +183,24 @@ class ClientBuilder:
         client.network = NetworkService(client.chain, cfg.network,
                                         processor=client.processor)
         client.network.start()
-        client.discovery = Discovery(client.network)
-        # advertise our subscribed subnets in the ENR (discovery/enr.rs)
-        n_subnets = client.chain.spec.preset.max_committees_per_slot
-        client.discovery.update_attnets((1 << n_subnets) - 1)
+        client.discovery = Discovery(client.network,
+                                     udp_port=cfg.discovery_port)
+        try:
+            # bootnode-free restart from the persisted routing table
+            client.discovery.load_persisted(client.chain.store)
+        except Exception:               # advisory
+            pass
+        if cfg.network.upnp_enabled:
+            from ..network.nat import establish_mappings
+            client.nat = establish_mappings(client.network.port,
+                                            client.discovery.disc.port)
+        # advertise EXACTLY the attestation subnets the service
+        # subscribed (all, or the two node-id-derived defaults) — an ENR
+        # must not under/over-claim what the node serves (r5 review)
+        attnets = 0
+        for subnet in client.network.attnet_subnets:
+            attnets |= 1 << subnet
+        client.discovery.update_attnets(attnets)
         client.discovery.update_syncnets(0b1111)
 
         # http api + metrics

@@ -106,6 +106,22 @@ class Discovery:
     def stop(self) -> None:
         self.disc.stop()
 
+    # -- routing-table persistence (network/src/persisted_dht.rs) ------------
+
+    def load_persisted(self, store) -> int:
+        """Seed the K-buckets from the database — restart without
+        bootnodes (invalid records are dropped at decode)."""
+        from .persisted_dht import load_dht
+        enrs = load_dht(store)
+        for e in enrs:
+            self.disc.table.update(e)
+        return len(enrs)
+
+    def persist(self, store) -> int:
+        """Write the current routing table to the database."""
+        from .persisted_dht import persist_dht
+        return persist_dht(store, self.disc.table.all())
+
 
 class BootNode:
     """Standalone discv5 server: routing table only, no beacon stack

@@ -128,8 +128,16 @@ class GossipEngine:
                                                daemon=True)
             self._hb_thread.start()
 
-    def stop(self) -> None:
+    def stop(self, join: bool = True) -> None:
+        """Stop the heartbeat; by default WAIT for the thread to exit so
+        callers can tear sockets down afterwards without the heartbeat
+        racing a closed transport (clean-shutdown discipline,
+        task_executor/src/lib.rs:12-28)."""
         self._hb_stop.set()
+        t = self._hb_thread
+        if join and t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=2)
 
     def on_peer_connected(self, peer) -> None:
         rpc = pb.Rpc(subscriptions=[

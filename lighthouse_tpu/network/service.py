@@ -18,6 +18,7 @@ from .peer_manager import PeerManager
 from .rpc import RpcHandler, StatusMessage
 from .sync import SyncManager, encode_block
 from .transport import Transport
+from .yamux import YamuxError
 
 
 @dataclass
@@ -26,6 +27,13 @@ class NetworkConfig:
     port: int = 0
     target_peers: int = 16
     boot_nodes: list = None
+    # UPnP port-mapping attempt at startup (network/src/nat.rs); off by
+    # default — it multicasts on the LAN
+    upnp_enabled: bool = False
+    # False -> serve only two node-id-derived attestation subnets (the
+    # reference's default per-node load); the ENR advertisement must
+    # match what is actually subscribed
+    subscribe_all_subnets: bool = True
 
 
 class NetworkService:
@@ -72,7 +80,14 @@ class NetworkService:
         self.gossip.subscribe(Topic.VOLUNTARY_EXIT)
         self.gossip.subscribe(Topic.PROPOSER_SLASHING)
         self.gossip.subscribe(Topic.ATTESTER_SLASHING)
-        for subnet in range(chain.spec.preset.max_committees_per_slot):
+        n_subnets = chain.spec.preset.max_committees_per_slot
+        if self.config.subscribe_all_subnets:
+            self.attnet_subnets = list(range(n_subnets))
+        else:
+            nid = int(self.transport.node_id[:16], 16)
+            self.attnet_subnets = sorted({nid % n_subnets,
+                                          (nid + 1) % n_subnets})
+        for subnet in self.attnet_subnets:
             self.gossip.subscribe(Topic.attestation_subnet(subnet))
         for subnet in range(4):
             self.gossip.subscribe(Topic.sync_subnet(subnet))
@@ -114,7 +129,9 @@ class NetworkService:
             self.dial(host, port)
 
     def stop(self) -> None:
-        self.gossip.stop()
+        # order matters: stop (and JOIN) the heartbeat before closing
+        # sockets, so no service thread is mid-write at teardown
+        self.gossip.stop(join=True)
         self.transport.stop()
 
     def dial(self, host: str, port: int):
@@ -153,7 +170,10 @@ class NetworkService:
             resp = self.rpc.request(peer, "status",
                                     self.local_status().to_json())
             status = StatusMessage.from_json(resp)
-        except (TimeoutError, RuntimeError, KeyError, ValueError):
+        except (TimeoutError, RuntimeError, KeyError, ValueError,
+                OSError, YamuxError):
+            # OSError/YamuxError: the peer tore down mid-exchange — this
+            # runs on its own thread, so failures must not escape
             return
         if status.fork_digest != self.gossip.fork_digest:
             try:

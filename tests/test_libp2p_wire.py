@@ -129,13 +129,22 @@ class TestMultistream:
 
     def test_all_refused(self):
         a, b = _sock_pair()
-        t = threading.Thread(
-            target=lambda: ms.negotiate_in(b, ["/noise"]))
+
+        def listener():
+            # the dialer gives up after the refusal and closes its end;
+            # the responder's next read failing is the expected outcome
+            try:
+                ms.negotiate_in(b, ["/noise"])
+            except (ms.MultistreamError, OSError):
+                pass
+
+        t = threading.Thread(target=listener)
         t.start()
         with pytest.raises(ms.MultistreamError):
             ms.negotiate_out(a, ["/tls/1.0.0"])
+        a.close()
         t.join()
-        a.close(); b.close()
+        b.close()
 
     def test_varint_multibyte(self):
         data = []
