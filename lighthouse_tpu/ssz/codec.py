@@ -213,6 +213,10 @@ def _split_variable(data: bytes) -> list[bytes]:
     first = int.from_bytes(data[:BYTES_PER_LENGTH_OFFSET], "little")
     if first % BYTES_PER_LENGTH_OFFSET or first == 0:
         raise DeserializeError("bad first offset")
+    if first > len(data):
+        # bound BEFORE allocating the offset table: a corrupted first
+        # offset must not drive a multi-GB allocation (r5 fuzz review)
+        raise DeserializeError("first offset beyond data")
     n = first // BYTES_PER_LENGTH_OFFSET
     offsets = [int.from_bytes(
         data[i * 4:(i + 1) * 4], "little") for i in range(n)]
@@ -239,10 +243,14 @@ def _deserialize_container(typ: Container, data: bytes) -> Any:
             fixed_raw.append((name, t, off))
             offsets.append(off)
             pos += 4
+    if not offsets and len(data) != pos:
+        # fully-fixed container: decoding must consume EVERY byte —
+        # trailing garbage is a distinct wire form for the same value
+        # (found by the r5 SSZ fuzzer, tests/test_fuzz.py)
+        raise DeserializeError("container length mismatch")
     offsets.append(len(data))
-    if offsets and offsets[0] != pos and len(offsets) > 1:
-        if offsets[0] != pos:
-            raise DeserializeError("first offset != fixed size")
+    if len(offsets) > 1 and offsets[0] != pos:
+        raise DeserializeError("first offset != fixed size")
     kw = {}
     oi = 0
     for name, t, raw in fixed_raw:
