@@ -379,8 +379,14 @@ def build_get_routes(backend: ApiBackend):
         # (v2 validator block production is served as raw SSZ by the
         # do_GET special case, alongside the v3 builder-aware entry)
         # -- electra v2 pool views --
+        # v2: fork-versioned payload + Eth-Consensus-Version header
+        # (electra attester-slashing variants, http_api v2 semantics)
         (re.compile(r"^/eth/v2/beacon/pool/attester_slashings$"),
-         lambda m, q: {"data": backend.pool_ops("attester_slashings")}),
+         lambda m, q: Resp(
+             payload_fn=lambda: (
+                 {"version": (v := backend.chain.spec.fork_name_at_slot(
+                     backend.chain.slot()).name.lower()),
+                  "data": backend.pool_ops("attester_slashings")}, v))),
         (re.compile(r"^/eth/v2/beacon/pool/attestations$"),
          lambda m, q: {"data": backend.pool_attestations()}),
         # -- round-3 additions: analysis, ops, readiness, ws ----------------
@@ -606,6 +612,18 @@ def _make_handler(backend: ApiBackend):
                 if url.path == "/eth/v1/validator/register_validator":
                     backend.register_validator(json.loads(body))
                     return self._json(200, {})
+                if url.path == "/eth/v2/beacon/pool/attester_slashings":
+                    # v2: the payload type follows the declared (or
+                    # current-fork) consensus version — electra carries
+                    # the larger committee-bits indexed attestations
+                    from ..specs.chain_spec import ForkName
+                    fork = self._block_fork(chain)
+                    cls = (chain.T.AttesterSlashingElectra
+                           if fork >= ForkName.ELECTRA
+                           else chain.T.AttesterSlashing)
+                    obj = deserialize(cls.ssz_type, body)
+                    backend.submit_pool_op("attester_slashings", obj)
+                    return self._json(200, {})
                 pool_types = {
                     "attester_slashings": "AttesterSlashing",
                     "proposer_slashings": "ProposerSlashing",
@@ -673,16 +691,6 @@ def _make_handler(backend: ApiBackend):
                              if fork >= ForkName.ELECTRA
                              else chain.T.Attestation.ssz_type)
                     backend.publish_attestation(deserialize(att_t, body))
-                    return self._json(200, {})
-                if url.path == "/eth/v2/beacon/pool/attester_slashings":
-                    from ..specs.chain_spec import ForkName
-                    fork = chain.spec.fork_name_at_slot(chain.slot())
-                    cls = (chain.T.AttesterSlashingElectra
-                           if fork >= ForkName.ELECTRA
-                           else chain.T.AttesterSlashing)
-                    backend.submit_pool_op(
-                        "attester_slashings",
-                        deserialize(cls.ssz_type, body))
                     return self._json(200, {})
                 if url.path == "/lighthouse/database/reconstruct":
                     return self._json(200, {"data": "started"})
