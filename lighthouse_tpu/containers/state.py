@@ -421,16 +421,23 @@ class ValidatorRegistry:
 
 
 class BalancesColumn:
-    """Device-resident packed-uint64 balances column with dirty-chunk
-    scatter — the List[uint64, VALIDATOR_REGISTRY_LIMIT] analog of the
-    registry's milhouse-style leaf cache (4 balances per 32-byte chunk).
+    """Device-resident packed-uint column with dirty-chunk scatter — the
+    List[uintN, VALIDATOR_REGISTRY_LIMIT] analog of the registry's
+    milhouse-style leaf cache (32/itemsize elements per 32-byte chunk).
 
-    Steady-state rehash after k point-mutations moves only ceil(k/4)
-    chunks host->device; the merkle sweep itself is all-device.
+    Parametrized over the element dtype (round 5): uint64 carries
+    balances and inactivity_scores, uint8 the participation columns —
+    every n-sized state column now shares this incremental tree.
+
+    Steady-state rehash after k point-mutations moves only
+    ceil(k/per_chunk) chunks host->device; the merkle sweep itself is
+    all-device.
     """
 
-    def __init__(self, values: np.ndarray):
-        self.values = np.ascontiguousarray(values, dtype=np.uint64)
+    def __init__(self, values: np.ndarray, dtype=np.uint64):
+        self.dtype = np.dtype(dtype)
+        self.per_chunk = 32 // self.dtype.itemsize
+        self.values = np.ascontiguousarray(values, dtype=self.dtype)
         self._device_leaves = None   # legacy slot, kept for test/bench resets
         self._device_tree = None
         self._host_tree = None
@@ -446,7 +453,9 @@ class BalancesColumn:
         copy-on-write (the host tree clones on next update; the device
         tree switches to the non-donating program)."""
         out = BalancesColumn.__new__(BalancesColumn)
-        out.values = np.ascontiguousarray(values, dtype=np.uint64)
+        out.dtype = self.dtype
+        out.per_chunk = self.per_chunk
+        out.values = np.ascontiguousarray(values, dtype=self.dtype)
         out._device_leaves = None
         out._device_tree = (self._device_tree.share()
                             if self._device_tree is not None else None)
@@ -460,24 +469,26 @@ class BalancesColumn:
         return out
 
     def _chunk_bytes(self, chunks: np.ndarray | None = None) -> np.ndarray:
-        """u8[C, 32] packed-u64 chunk bytes (4 balances per chunk), for
-        the whole column or a chunk subset — the single source of the
+        """u8[C, 32] packed chunk bytes (per_chunk elements per chunk),
+        for the whole column or a chunk subset — the single source of the
         chunk layout for both the host and device paths."""
         n = len(self)
+        pc = self.per_chunk
+        le = self.dtype.newbyteorder("<")
         if chunks is None:
-            n_chunks = (n + 3) // 4
-            padded = np.zeros(n_chunks * 4, dtype=np.uint64)
+            n_chunks = (n + pc - 1) // pc
+            padded = np.zeros(n_chunks * pc, dtype=self.dtype)
             padded[:n] = self.values
         else:
-            padded = np.zeros((len(chunks), 4), dtype=np.uint64)
+            padded = np.zeros((len(chunks), pc), dtype=self.dtype)
             for j, c in enumerate(chunks):
-                vals = self.values[c * 4:c * 4 + 4]
+                vals = self.values[c * pc:c * pc + pc]
                 padded[j, :len(vals)] = vals
-        return np.frombuffer(padded.astype("<u8").tobytes(),
+        return np.frombuffer(padded.astype(le).tobytes(),
                              np.uint8).reshape(-1, 32)
 
     def _chunk_words(self, chunks: np.ndarray | None = None) -> np.ndarray:
-        """u32[C, 8] big-endian words of the packed-u64 chunks."""
+        """u32[C, 8] big-endian words of the packed chunks."""
         from ..ops import sha256 as k
         return k.chunks_to_words(self._chunk_bytes(chunks).tobytes())
 
@@ -486,13 +497,14 @@ class BalancesColumn:
         place the invalidation invariant lives)."""
         self._root_cache = None
         if self._dirty_chunks is not None:
-            self._dirty_chunks.add(int(i) // 4)
+            self._dirty_chunks.add(int(i) // self.per_chunk)
 
     def set_many(self, rows: np.ndarray, values: np.ndarray) -> None:
         self.values[rows] = values
         self._root_cache = None
         if self._dirty_chunks is not None:
-            self._dirty_chunks.update(int(r) // 4 for r in np.unique(rows))
+            self._dirty_chunks.update(int(r) // self.per_chunk
+                                      for r in np.unique(rows))
 
     def set(self, i: int, value: int) -> None:
         self.values[i] = value
@@ -500,7 +512,7 @@ class BalancesColumn:
 
     def replace(self, values: np.ndarray) -> None:
         """Wholesale column replacement (epoch-processing rewards sweep)."""
-        self.values = np.ascontiguousarray(values, dtype=np.uint64)
+        self.values = np.ascontiguousarray(values, dtype=self.dtype)
         self._root_cache = None
         self._dirty_chunks = None
 
@@ -508,7 +520,8 @@ class BalancesColumn:
         """Incremental device tree root over the packed-u64 chunk leaves
         (same fused build/update programs as the validator registry)."""
         from ..ops.merkle_tree import DeviceTree
-        n_chunks = (len(self) + 3) // 4
+        pc = self.per_chunk
+        n_chunks = (len(self) + pc - 1) // pc
         tree = self._device_tree
         if tree is None or self._dirty_chunks is None or tree.n != n_chunks:
             tree = DeviceTree(n_chunks, limit_chunks)
@@ -527,13 +540,13 @@ class BalancesColumn:
             return self._root_cache
         from ..ops import sha256 as k
         n = len(self)
-        limit_chunks = (registry_limit * 8 + 31) // 32
+        limit_chunks = (registry_limit * self.dtype.itemsize + 31) // 32
         if n == 0:
             depth = (limit_chunks - 1).bit_length()
             root = mix_in_length(ZERO_HASHES[depth], 0)
         elif _use_host_hash():
             from ..utils import native_hash as nh
-            n_chunks = (n + 3) // 4
+            n_chunks = (n + self.per_chunk - 1) // self.per_chunk
             tree = getattr(self, "_host_tree", None)
             if tree is None or self._dirty_chunks is None \
                     or tree.n != n_chunks:
@@ -658,6 +671,17 @@ def active_field_specs(T: Types, fork: ForkName) -> list[FieldSpec]:
             if f.since <= fork and (f.until is None or fork < f.until)]
 
 
+# n-sized packed columns with incremental trees:
+# field -> (cache attr, element dtype) — ONE source of truth for both
+# the __setattr__ normalization and the _column_root cache construction
+_COLUMN_CACHES = {
+    "balances": ("_balances_cache", np.uint64),
+    "inactivity_scores": ("_inactivity_cache", np.uint64),
+    "previous_epoch_participation": ("_prev_part_cache", np.uint8),
+    "current_epoch_participation": ("_curr_part_cache", np.uint8),
+}
+
+
 class BeaconState:
     """One class for all forks; fields outside the active fork are None.
 
@@ -670,21 +694,46 @@ class BeaconState:
     a full rebuild."""
 
     _balances_cache: "BalancesColumn | None" = None
+    _inactivity_cache: "BalancesColumn | None" = None
+    _prev_part_cache: "BalancesColumn | None" = None
+    _curr_part_cache: "BalancesColumn | None" = None
 
     def __setattr__(self, name, value):
-        if name == "balances":
-            object.__setattr__(self, "_balances_cache", None)
+        if name in _COLUMN_CACHES:
+            attr, dtype = _COLUMN_CACHES[name]
+            object.__setattr__(self, attr, None)
             # normalize so BalancesColumn(value) aliases rather than
             # copies — a copy would defeat the `cache.values is v`
             # freshness check and silently degrade to full rebuilds
             if isinstance(value, np.ndarray):
-                value = np.ascontiguousarray(value, dtype=np.uint64)
+                value = np.ascontiguousarray(value, dtype=dtype)
         object.__setattr__(self, name, value)
 
     def mark_balances_dirty(self, index: int) -> None:
         cache = self._balances_cache
         if cache is not None:
             cache.mark_dirty(index)
+
+    def mark_participation_dirty(self, indices, current: bool) -> None:
+        """In-place participation-flag mutations (process_attestation)
+        must report the touched rows here, mirroring the balances
+        discipline."""
+        cache = self._curr_part_cache if current else self._prev_part_cache
+        if cache is not None:
+            for i in indices:
+                cache.mark_dirty(int(i))
+
+    def rotate_participation(self) -> None:
+        """Epoch rotation: previous <- current with the primed tree
+        cache handed off O(1) (the installed array IS the one the
+        current-cache holds a complete tree for), current <- zeros."""
+        cache = self._curr_part_cache
+        self.previous_epoch_participation = self.current_epoch_participation
+        if cache is not None and \
+                cache.values is self.previous_epoch_participation:
+            object.__setattr__(self, "_prev_part_cache", cache)
+        self.current_epoch_participation = np.zeros(
+            len(self.validators), np.uint8)
 
     def __init__(self, T: Types, spec: ChainSpec, fork_name: ForkName):
         self.T = T
@@ -827,10 +876,13 @@ class BeaconState:
         for f in state_field_specs(self.T):
             if not hasattr(out, f.name):
                 setattr(out, f.name, None)
-        # share the balances tree cache copy-on-write over the copied array
-        if self._balances_cache is not None:
-            object.__setattr__(out, "_balances_cache",
-                               self._balances_cache.fork(out.balances))
+        # share the packed-column tree caches copy-on-write over the
+        # copied arrays (balances, inactivity, participation)
+        for field, (attr, _dt) in _COLUMN_CACHES.items():
+            cache = getattr(self, attr)
+            if cache is not None and getattr(out, field, None) is not None:
+                object.__setattr__(out, attr,
+                                   cache.fork(getattr(out, field)))
         return out
 
     # -- merkleization -------------------------------------------------------
@@ -852,18 +904,29 @@ class BeaconState:
         if f.kind == "u64_vec":
             return _np_uint_root(v, (f.limit * 8 + 31) // 32)
         if f.kind == "u64_list":
-            if f.name == "balances" and len(v):
-                cache = self._balances_cache
-                if cache is None or cache.values is not v:
-                    cache = BalancesColumn(v)
-                    object.__setattr__(self, "_balances_cache", cache)
-                return cache.hash_tree_root(f.limit)
+            if f.name in _COLUMN_CACHES and len(v):
+                return self._column_root(f, v, np.uint64)
             return _np_uint_root(v, (f.limit * 8 + 31) // 32, length=len(v))
         if f.kind == "u8_list":
+            if f.name in _COLUMN_CACHES and len(v):
+                return self._column_root(f, v, np.uint8)
             return _np_uint_root(v, (f.limit + 31) // 32, length=len(v))
         if f.kind == "validators":
             return v.hash_tree_root(f.limit)
         raise TypeError(f.kind)
+
+    def _column_root(self, f: FieldSpec, v: np.ndarray, dtype) -> bytes:
+        """Incremental packed-column root (balances, inactivity_scores,
+        participation): the cache is keyed on ARRAY IDENTITY, so
+        wholesale replacements (epoch sweeps, appends) rebuild and
+        unchanged columns reuse the cached root; in-place point
+        mutations must go through the mark_*_dirty hooks."""
+        attr, _dtype = _COLUMN_CACHES[f.name]
+        cache = getattr(self, attr)
+        if cache is None or cache.values is not v:
+            cache = BalancesColumn(v, dtype=dtype)
+            object.__setattr__(self, attr, cache)
+        return cache.hash_tree_root(f.limit)
 
     def hash_tree_root(self) -> bytes:
         specs = active_field_specs(self.T, self.fork_name)

@@ -341,6 +341,7 @@ def process_attestation(state: BeaconState, attestation,
         participation = state.previous_epoch_participation
     total_active = get_total_active_balance(state)
     proposer_reward_numerator = 0
+    touched = []
     for index in indexed.attesting_indices:
         current = int(participation[index])
         for fi in flag_indices:
@@ -348,7 +349,12 @@ def process_attestation(state: BeaconState, attestation,
                 current = add_flag(current, fi)
                 proposer_reward_numerator += get_base_reward_altair(
                     state, index, total_active) * PARTICIPATION_FLAG_WEIGHTS[fi]
-        participation[index] = current
+        if current != int(participation[index]):
+            participation[index] = current
+            touched.append(index)
+    if touched:
+        state.mark_participation_dirty(
+            touched, participation is state.current_epoch_participation)
     denom = (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR \
         // PROPOSER_WEIGHT
     increase_balance(state, get_beacon_proposer_index(state),

@@ -372,9 +372,8 @@ def _process_historical_update(state: BeaconState) -> None:
 
 
 def _process_participation_flag_updates(state: BeaconState) -> None:
-    state.previous_epoch_participation = state.current_epoch_participation
-    state.current_epoch_participation = np.zeros(
-        len(state.validators), np.uint8)
+    # previous <- current hands the primed column tree off O(1)
+    state.rotate_participation()
 
 
 def _process_sync_committee_updates(state: BeaconState) -> None:

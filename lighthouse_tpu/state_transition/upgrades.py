@@ -34,6 +34,7 @@ def upgrade_to_altair(state: BeaconState) -> None:
     state.current_epoch_participation = np.zeros(n, np.uint8)
     state.inactivity_scores = np.zeros(n, np.uint64)
     # translate_participation: replay previous-epoch pending attestations
+    touched = []
     for att in pending:
         try:
             flags = get_attestation_participation_flag_indices(
@@ -45,6 +46,11 @@ def upgrade_to_altair(state: BeaconState) -> None:
             for fi in flags:
                 cur = add_flag(cur, fi)
             state.previous_epoch_participation[i] = cur
+            touched.append(i)
+    if touched:
+        # in-place column writes must report dirty rows (state.py
+        # _column_root invariant)
+        state.mark_participation_dirty(touched, current=False)
     committee = get_next_sync_committee(state)
     state.current_sync_committee = committee
     state.next_sync_committee = get_next_sync_committee(state)

@@ -222,3 +222,42 @@ def test_balances_column_empty():
     vrl = 2**40
     assert bc.hash_tree_root(vrl) == _np_uint_root(
         np.zeros(0, np.uint64), (vrl * 8 + 31) // 32, length=0)
+
+
+def test_packed_column_caches_cover_all_n_sized_fields():
+    """Round 5 (milhouse generality): inactivity_scores and both
+    participation columns ride the same incremental packed-column tree
+    as balances — cached roots match full rebuilds after in-place marks,
+    wholesale swaps, and copies."""
+    import numpy as np
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.specs import minimal_spec
+
+    bls.set_backend("fake")
+    try:
+        h = BeaconChainHarness(minimal_spec(altair_fork_epoch=0), 32)
+        st = h.chain.head().head_state.copy()
+        root0 = st.hash_tree_root()           # primes all column caches
+        assert st._inactivity_cache is not None
+        assert st._curr_part_cache is not None
+        # in-place participation mutation through the mark hook
+        st.current_epoch_participation[5] |= 0b111
+        st.mark_participation_dirty([5], current=True)
+        st.inactivity_scores = st.inactivity_scores + 4   # wholesale
+        incremental = st.hash_tree_root()
+        # ground truth: a state rebuilt from serialized bytes (no caches)
+        from lighthouse_tpu.containers.state import BeaconState
+        fresh = BeaconState.from_ssz_bytes(st.serialize(), st.T, st.spec,
+                                           st.fork_name)
+        assert incremental == fresh.hash_tree_root() != root0
+        # copies fork the caches copy-on-write and stay correct
+        cp = st.copy()
+        cp.current_epoch_participation[6] |= 0b1
+        cp.mark_participation_dirty([6], current=True)
+        fresh2 = BeaconState.from_ssz_bytes(cp.serialize(), cp.T, cp.spec,
+                                            cp.fork_name)
+        assert cp.hash_tree_root() == fresh2.hash_tree_root()
+        assert st.hash_tree_root() == incremental     # original untouched
+    finally:
+        bls.set_backend("python")
