@@ -19,6 +19,14 @@ from ..state_transition.helpers import (
 )
 
 
+def _empty_deposit_snapshot() -> dict:
+    from ..eth1.deposit_snapshot import DepositTree
+    return DepositTree().get_snapshot().to_json()
+
+
+_EMPTY_DEPOSIT_SNAPSHOT = None
+
+
 class ApiError(Exception):
     def __init__(self, status: int, message: str):
         self.status = status
@@ -990,15 +998,19 @@ class ApiBackend:
         return out
 
     def deposit_snapshot(self) -> dict:
+        """The REAL EIP-4881 snapshot (finalized node hashes included) —
+        a fresh node resumes the deposit tree from this instead of
+        replaying historical logs (http_api get_deposit_snapshot)."""
         svc = self.chain.eth1_service
         if svc is None:
-            return {"deposit_root": "0x" + b"\x00" * 32 .hex()
-                    if False else "0x" + (b"\x00" * 32).hex(),
-                    "deposit_count": "0", "execution_block_height": "0"}
-        data = self.chain.head().head_state.eth1_data
-        return {"deposit_root": "0x" + data.deposit_root.hex(),
-                "deposit_count": str(data.deposit_count),
-                "execution_block_height": "0"}
+            # no eth1 tracker attached: the empty snapshot (deliberate
+            # divergence from the reference's 404 — an offline/interop
+            # node still answers with a resumable-from-genesis snapshot)
+            global _EMPTY_DEPOSIT_SNAPSHOT
+            if _EMPTY_DEPOSIT_SNAPSHOT is None:
+                _EMPTY_DEPOSIT_SNAPSHOT = _empty_deposit_snapshot()
+            return _EMPTY_DEPOSIT_SNAPSHOT
+        return svc.get_deposit_snapshot().to_json()
 
     def deposit_cache(self) -> list[dict]:
         svc = self.chain.eth1_service

@@ -78,6 +78,10 @@ class Eth1Service:
         self.deposit_logs: list[DepositLog] = []
         self._proof_trees: dict[int, MerkleTree] = {}  # deposit_count -> tree
         self.finalized_deposit_count = 0
+        # EIP-4881 snapshot twin: finalizable prefix + resumable snapshot
+        from .deposit_snapshot import DepositTree
+        self.deposit_tree_4881 = DepositTree()
+        self._pending_4881_finalize: tuple | None = None
         self._lock = threading.Lock()
 
     # -- finalization pruning (eth1_finalization_cache.rs consumer) ----------
@@ -96,12 +100,42 @@ class Eth1Service:
             for k in [k for k in self._proof_trees if k < count]:
                 del self._proof_trees[k]
             keep_from = 0
+            # the snapshot's execution block must match the TREE's
+            # finalization point (deposit_index), not the vote count —
+            # a resuming node scans logs from this block onward
+            fin_block = (b"\x00" * 32, 0)
             for i, b in enumerate(self.block_cache):
                 if b.deposit_count <= int(snap["deposit_count"]):
                     keep_from = i
+                if b.deposit_count <= count:
+                    fin_block = (b.hash, b.number)
             # keep the newest pre-finalization block (votes may reference
             # it) and everything after
             self.block_cache = self.block_cache[keep_from:]
+            # EIP-4881: collapse the finalized prefix to snapshot hashes;
+            # if the poller hasn't imported that many logs yet, remember
+            # the target and retry once update() catches up
+            if count <= self.deposit_tree_4881.count:
+                self.deposit_tree_4881.finalize(count, fin_block[0],
+                                                fin_block[1])
+                self._pending_4881_finalize = None
+            else:
+                self._pending_4881_finalize = (count, fin_block)
+
+    def _retry_pending_finalize(self) -> None:
+        """Called (under the lock) after log import: apply a snapshot
+        finalization that arrived before its logs did."""
+        pending = self._pending_4881_finalize
+        if pending is not None and \
+                pending[0] <= self.deposit_tree_4881.count:
+            self.deposit_tree_4881.finalize(pending[0], pending[1][0],
+                                            pending[1][1])
+            self._pending_4881_finalize = None
+
+    def get_deposit_snapshot(self):
+        """The resumable EIP-4881 snapshot (http_api get_deposit_snapshot)."""
+        with self._lock:
+            return self.deposit_tree_4881.get_snapshot()
 
     # -- polling (service.rs update loop) ------------------------------------
 
@@ -122,7 +156,10 @@ class Eth1Service:
                 have = len(self.deposit_logs)
                 for log in self.endpoint.deposit_logs_in_range(have, count):
                     self.deposit_logs.append(log)
-                    self.deposit_tree.push_leaf(htr(log.deposit_data))
+                    leaf = htr(log.deposit_data)
+                    self.deposit_tree.push_leaf(leaf)
+                    self.deposit_tree_4881.push_leaf(leaf)
+                self._retry_pending_finalize()
 
     # -- eth1 data votes (get_eth1_vote) -------------------------------------
 
