@@ -19,14 +19,6 @@ from ..state_transition.helpers import (
 )
 
 
-def _empty_deposit_snapshot() -> dict:
-    from ..eth1.deposit_snapshot import DepositTree
-    return DepositTree().get_snapshot().to_json()
-
-
-_EMPTY_DEPOSIT_SNAPSHOT = None
-
-
 class ApiError(Exception):
     def __init__(self, status: int, message: str):
         self.status = status
@@ -1005,11 +997,10 @@ class ApiBackend:
         if svc is None:
             # no eth1 tracker attached: the empty snapshot (deliberate
             # divergence from the reference's 404 — an offline/interop
-            # node still answers with a resumable-from-genesis snapshot)
-            global _EMPTY_DEPOSIT_SNAPSHOT
-            if _EMPTY_DEPOSIT_SNAPSHOT is None:
-                _EMPTY_DEPOSIT_SNAPSHOT = _empty_deposit_snapshot()
-            return _EMPTY_DEPOSIT_SNAPSHOT
+            # node still answers with a resumable-from-genesis snapshot);
+            # fresh dict per request, callers may post-process in place
+            from ..eth1.deposit_snapshot import DepositTree
+            return DepositTree().get_snapshot().to_json()
         return svc.get_deposit_snapshot().to_json()
 
     def deposit_cache(self) -> list[dict]:

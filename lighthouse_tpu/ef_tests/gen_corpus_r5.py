@@ -544,11 +544,81 @@ def gen_electra_epoch(root) -> int:
     return n
 
 
+def gen_electra_sanity(root) -> int:
+    """electra sanity/slots: the COMPOSED epoch transition on an electra
+    state (pending queues + compounding balances + electra registry),
+    scalar-verified piecewise at generation time."""
+    from ..containers import get_types
+    from ..state_transition import process_slots
+    from .gen_corpus import w_yaml
+    n = 0
+    state, _keys, spec = _genesis("electra", 16)
+    T = get_types(spec.preset)
+    # the GENESIS-epoch boundary: rewards/justification/inactivity are
+    # skipped by spec, so the composed transition's balance effects come
+    # EXACTLY from the electra queues — piecewise scalar-checkable
+    _age_last_slot(state, 0)
+    # make the boundary DO electra-specific work: a queued finalized
+    # deposit, a due consolidation, and a compounding balance excess
+    state.pending_deposits = [
+        T.PendingDeposit(pubkey=bytes(state.validators.pubkeys[2]),
+                         withdrawal_credentials=b"\x00" * 32,
+                         amount=3 * ETH, signature=b"\x00" * 96, slot=0)]
+    state.validators.set_field(4, "exit_epoch", 0)
+    state.validators.set_field(4, "withdrawable_epoch", 1)
+    state.pending_consolidations = [
+        T.PendingConsolidation(source_index=4, target_index=5)]
+    _set_wc(state, 6, sse.COMPOUNDING_PREFIX)
+    _set_balance(state, 6, 80 * ETH)
+
+    # scalar expectations computed on the PRE state (the epoch order
+    # runs these sub-transitions before effective-balance updates read
+    # the moved balances — so compose them scalar-side too)
+    exp_deposits = sse.pending_deposits_expected(state)
+    d = wcase(root, "minimal", "electra", "sanity", "slots",
+              "pyspec_tests", "epoch_boundary_queues")
+    _write_state(d, "pre.ssz_snappy", state)
+    w_yaml(d, "slots.yaml", 1)
+    post = state.copy()
+    process_slots(post, state.slot + 1)
+    # piecewise scalar verification of the electra-specific outcomes
+    from .scalar_spec import _ck
+    _ck(len(post.pending_deposits) == len(exp_deposits["queue"]),
+        "sanity: pending deposit queue")
+    _ck(int(post.balances[2])
+        == int(state.balances[2]) + 3 * ETH, "sanity: deposit applied")
+    _ck(len(post.pending_consolidations) == 0,
+        "sanity: consolidation consumed")
+    _ck(int(post.balances[5]) > int(state.balances[5]),
+        "sanity: consolidation moved balance")
+    _ck(int(post.validators.effective_balance[6])
+        == sse.effective_balance_updates_electra(_pre_eb_state(state,
+                                                               post))[6],
+        "sanity: compounding effective balance")
+    _write_state(d, "post.ssz_snappy", post)
+    n += 1
+    return n
+
+
+def _pre_eb_state(pre, post):
+    """Effective-balance updates read balances AFTER the earlier epoch
+    steps ran; lend the scalar transcription that intermediate view:
+    pre-state rows with post-step balances."""
+    class _View:
+        pass
+    v = _View()
+    v.validators = pre.validators
+    v.balances = post.balances
+    v.slot = pre.slot
+    return v
+
+
 def generate_all(root, only: list[str] | None = None) -> int:
     gens = {
         "electra_operations": gen_electra_operations,
         "capella_operations": gen_capella_operations,
         "electra_epoch": gen_electra_epoch,
+        "electra_sanity": gen_electra_sanity,
     }
     n = 0
     for name, fn in gens.items():

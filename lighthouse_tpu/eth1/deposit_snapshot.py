@@ -191,6 +191,11 @@ class DepositTree:
 
     @classmethod
     def from_snapshot(cls, snap: DepositTreeSnapshot) -> "DepositTree":
+        # a left-packed prefix of N deposits collapses to exactly
+        # popcount(N) finalized subtree hashes — anything else is a
+        # malformed snapshot and must reject cleanly, not IndexError
+        if len(snap.finalized) != bin(snap.deposit_count).count("1"):
+            raise ValueError("snapshot finalized-hash count mismatch")
         tree = cls()
         tree._root_node = _from_snapshot_node(
             list(snap.finalized), snap.deposit_count,

@@ -100,6 +100,13 @@ def test_eip4881_deposit_tree_snapshot_roundtrip():
                               snap.execution_block_height)
     with pytest.raises(ValueError):
         DepositTree.from_snapshot(bad)
+    # malformed hash count (popcount mismatch) rejects cleanly too
+    short = DepositTreeSnapshot(snap.finalized[:1], snap.deposit_root,
+                                snap.deposit_count,
+                                snap.execution_block_hash,
+                                snap.execution_block_height)
+    with pytest.raises(ValueError):
+        DepositTree.from_snapshot(short)
 
 
 def test_eth1_service_serves_snapshot():
