@@ -769,21 +769,61 @@ class ApiBackend:
     # -- node / debug --------------------------------------------------------
 
     def node_identity(self) -> dict:
+        """Real identity: the transport peer id, the signed discovery ENR
+        in its EIP-778 text form when discovery is attached, multiaddr
+        listen addresses, and the attnets the node actually serves."""
         net = getattr(self.chain, "network_service", None)
-        nid = net.transport.node_id if net else "0" * 16
-        return {"peer_id": nid, "enr": f"enr:-mini-{nid}",
-                "p2p_addresses": [], "discovery_addresses": [],
-                "metadata": {"seq_number": "1", "attnets": "0xff"}}
+        disc = getattr(self.chain, "discovery", None)
+        if net is None:
+            return {"peer_id": "0" * 16, "enr": "",
+                    "p2p_addresses": [], "discovery_addresses": [],
+                    "metadata": {"seq_number": "0",
+                                 "attnets": "0x" + "00" * 8}}
+        attnets = 0
+        for subnet in getattr(net, "attnet_subnets", []):
+            attnets |= 1 << subnet
+        enr_text, disc_addrs, seq = "", [], 1
+        if disc is not None:
+            enr_text = disc.enr.to_text()
+            seq = int(disc.enr.seq)
+            disc_addrs = [f"/ip4/{disc.disc.ip}/udp/{disc.disc.port}"]
+        return {
+            "peer_id": net.transport.node_id,
+            "enr": enr_text,
+            "p2p_addresses":
+                [f"/ip4/{net.transport.host}/tcp/{net.transport.port}"],
+            "discovery_addresses": disc_addrs,
+            "metadata": {"seq_number": str(seq),
+                         "attnets": "0x" + attnets.to_bytes(
+                             8, "little").hex()}}
 
-    def node_peers(self) -> list[dict]:
+    def node_peers(self, states: list | None = None,
+                   directions: list | None = None) -> list[dict]:
+        """Spec-shaped peer rows with real direction + last-seen
+        multiaddr from the transport; the query filters are REPEATABLE
+        with OR semantics like the reference (?state=a&state=b)."""
         net = getattr(self.chain, "network_service", None)
         if net is None:
             return []
         out = []
         for info in net.peers.connected():
+            peer = net.transport.peers.get(info.node_id)
+            if peer is None:
+                # mid-disconnect race: the transport already dropped it;
+                # reporting it as connected/inbound would be wrong both
+                # ways (r5 review)
+                continue
+            host, port = peer.addr[0], peer.addr[1]
             out.append({"peer_id": info.node_id, "state": "connected",
-                        "direction": "outbound",
+                        "direction": ("outbound" if peer.outbound
+                                      else "inbound"),
+                        "last_seen_p2p_address":
+                            f"/ip4/{host}/tcp/{port}",
                         "score": str(info.score)})
+        if states:
+            out = [p for p in out if p["state"] in states]
+        if directions:
+            out = [p for p in out if p["direction"] in directions]
         return out
 
     def node_peer(self, peer_id: str) -> dict:

@@ -292,7 +292,8 @@ def build_get_routes(backend: ApiBackend):
         (re.compile(r"^/eth/v1/node/identity$"),
          lambda m, q: {"data": backend.node_identity()}),
         (re.compile(r"^/eth/v1/node/peers$"),
-         lambda m, q: {"data": backend.node_peers()}),
+         lambda m, q: {"data": backend.node_peers(
+             states=q.get("state"), directions=q.get("direction"))}),
         (re.compile(r"^/eth/v1/node/peers/([^/]+)$"),
          lambda m, q: {"data": backend.node_peer(m[1])}),
         (re.compile(r"^/eth/v1/node/peer_count$"),

@@ -81,7 +81,7 @@ class Eth1Service:
         # EIP-4881 snapshot twin: finalizable prefix + resumable snapshot
         from .deposit_snapshot import DepositTree
         self.deposit_tree_4881 = DepositTree()
-        self._pending_4881_finalize: int | None = None
+        self._pending_4881_finalize: tuple | None = None
         self._lock = threading.Lock()
 
     # -- finalization pruning (eth1_finalization_cache.rs consumer) ----------
@@ -120,7 +120,10 @@ class Eth1Service:
                                                 fin_block[1])
                 self._pending_4881_finalize = None
             else:
-                self._pending_4881_finalize = count
+                # keep the block captured from the PRE-pruned cache as a
+                # fallback: the retry scans the pruned cache and may not
+                # find any block at/below the finalization point
+                self._pending_4881_finalize = (count, fin_block)
 
     def _retry_pending_finalize(self) -> None:
         """Called (under the lock) after log import: apply a snapshot
@@ -128,10 +131,10 @@ class Eth1Service:
         block is recomputed NOW — the one cached at finalize() time
         predated the logs and would make resuming nodes re-scan deposits
         already inside the finalized prefix (r5 review)."""
-        count = self._pending_4881_finalize
-        if count is None or count > self.deposit_tree_4881.count:
+        pending = self._pending_4881_finalize
+        if pending is None or pending[0] > self.deposit_tree_4881.count:
             return
-        fin_block = (b"\x00" * 32, 0)
+        count, fin_block = pending
         for b in self.block_cache:
             if b.deposit_count <= count:
                 fin_block = (b.hash, b.number)

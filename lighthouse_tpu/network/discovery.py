@@ -30,6 +30,8 @@ class Discovery:
         self.disc = Discv5(ip=service.transport.host, port=udp_port,
                            tcp_port=service.port,
                            bootnodes=bootnode_enrs)
+        # only after the UDP bind succeeded (r5 review)
+        service.chain.discovery = self    # /eth/v1/node/identity ENR view
         self.disc.start()
         # addr -> transport peer id of the last successful dial, so a
         # dropped connection can be re-dialed on a later round

@@ -117,6 +117,10 @@ class NetworkService:
                           self._lc_optimistic_update)
         self.rpc.register("light_client_updates_by_range",
                           self._lc_updates_by_range)
+        # LAST: only a fully-constructed service may serve the
+        # /eth/v1/node/* API view (a failed Transport bind must leave
+        # chain.network_service unset — r5 review)
+        chain.network_service = self
 
     @property
     def port(self) -> int:
