@@ -25,8 +25,11 @@ ETH = 10**9
 
 def _spec(last_fork: str, n_extra: dict | None = None):
     from ..specs.chain_spec import minimal_spec
-    epochs = {"altair_fork_epoch": 0, "bellatrix_fork_epoch": 0,
-              "capella_fork_epoch": 0}
+    epochs = {"altair_fork_epoch": 0}
+    if last_fork in ("bellatrix", "capella", "deneb", "electra"):
+        epochs["bellatrix_fork_epoch"] = 0
+    if last_fork in ("capella", "deneb", "electra"):
+        epochs["capella_fork_epoch"] = 0
     if last_fork in ("deneb", "electra"):
         epochs["deneb_fork_epoch"] = 0
     if last_fork == "electra":
@@ -613,12 +616,99 @@ def _pre_eb_state(pre, post):
     return v
 
 
+def gen_mid_fork_epoch(root) -> int:
+    """bellatrix/capella/deneb epoch_processing: the fork-specific
+    pieces between altair and electra (bellatrix slashings multiplier,
+    capella/deneb effective-balance + registry behavior) — previously
+    these forks had NO epoch vectors at all."""
+    from ..specs.chain_spec import ForkName
+    from ..state_transition import epoch as ep
+    from ..state_transition.helpers import get_total_active_balance
+    from .scalar_spec import _ck, effective_balance_updates
+    n = 0
+
+    def run(fork_dir, handler, name, pre, fn, verify):
+        nonlocal n
+        d = wcase(root, "minimal", fork_dir, "epoch_processing", handler,
+                  "pyspec_tests", name)
+        _write_state(d, "pre.ssz_snappy", pre)
+        post = pre.copy()
+        fn(post)
+        verify(pre, post)
+        _write_state(d, "post.ssz_snappy", post)
+        n += 1
+
+    # bellatrix slashings: multiplier 3 with the pre-electra formula
+    state, _k, _spec_ = _genesis("bellatrix", 16)
+    _age_last_slot(state, 40)
+    for i in (1, 8):
+        state.validators.set_field(i, "slashed", True)
+        state.validators.set_field(i, "withdrawable_epoch", 40 + 32)
+    state.slashings[3] = 48 * ETH
+
+    def run_sl(st):
+        ep._process_slashings(st, ForkName.BELLATRIX,
+                              get_total_active_balance(st))
+
+    run("bellatrix", "slashings", "multiplier_three", state, run_sl,
+        lambda pre, post: _ck(
+            [int(b) for b in post.balances]
+            == sse.slashings_penalties_pre_electra(pre, 3),
+            "bellatrix slashings"))
+
+    # capella effective balances: pre-electra ceiling semantics
+    state, _k, _spec_ = _genesis("capella", 16)
+    _age_last_slot(state, 5)
+    _set_balance(state, 0, 40 * ETH)          # capped at 32 ETH effective
+    _set_balance(state, 1, 29 * ETH)          # hysteresis drop
+
+    run("capella", "effective_balance_updates", "pre_electra_ceiling",
+        state, ep._process_effective_balance_updates,
+        lambda pre, post: _ck(
+            [int(x) for x in post.validators.effective_balance]
+            == effective_balance_updates(pre), "capella effective"))
+
+    # deneb registry updates: 160 active validators make the validator
+    # churn limit 5, so the EIP-7514 activation cap (4 on minimal)
+    # BINDS — 6 eligible pending validators, exactly 4 may activate
+    state, _k, _spec_ = _genesis("deneb", 160)
+    _age_last_slot(state, 8)
+    from ..containers import get_types
+    T = get_types(_spec_.preset)
+    state.finalized_checkpoint = T.Checkpoint(epoch=7, root=b"\x44" * 32)
+    for i in (3, 4, 5, 6, 7, 10):
+        state.validators.set_field(i, "activation_eligibility_epoch", 5)
+        state.validators.set_field(i, "activation_epoch", sse.FAR_FUTURE)
+    state.validators.set_field(9, "effective_balance", 16 * ETH)
+
+    def run_ru(st):
+        ep._process_registry_updates(st, ForkName.DENEB)
+
+    def verify_ru(pre, post):
+        exp = sse.registry_updates_deneb(pre)
+        v = post.validators
+        for i, r in enumerate(exp):
+            _ck(int(v.activation_epoch[i]) == r["activation_epoch"],
+                f"deneb activation[{i}]")
+            _ck(int(v.exit_epoch[i]) == r["exit_epoch"],
+                f"deneb exit[{i}]")
+        activated = sum(
+            1 for i in (3, 4, 5, 6, 7, 10)
+            if int(v.activation_epoch[i]) != sse.FAR_FUTURE)
+        _ck(activated == 4, "EIP-7514 cap must bind at exactly 4")
+
+    run("deneb", "registry_updates", "eip7514_activation_cap_binds",
+        state, run_ru, verify_ru)
+    return n
+
+
 def generate_all(root, only: list[str] | None = None) -> int:
     gens = {
         "electra_operations": gen_electra_operations,
         "capella_operations": gen_capella_operations,
         "electra_epoch": gen_electra_epoch,
         "electra_sanity": gen_electra_sanity,
+        "mid_fork_epoch": gen_mid_fork_epoch,
     }
     n = 0
     for name, fn in gens.items():

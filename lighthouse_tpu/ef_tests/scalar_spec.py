@@ -378,8 +378,12 @@ def slashings_penalties(state) -> list[int]:
         if r["slashed"] and epoch + EPOCHS_PER_SLASHINGS // 2 == \
                 r["withdrawable_epoch"]:
             inc = INCREMENT
+            # spec order: penalty_numerator // total_balance * increment
+            # (the earlier transcription divided by total//inc — an
+            # increment-factor error masked by the zero-slashings altair
+            # vector; caught by the r5 bellatrix transcription)
             penalty_num = r["effective_balance"] // inc * adj
-            penalty = penalty_num // (total // inc) * inc
+            penalty = penalty_num // total * inc
             out[i] = max(0, out[i] - penalty)
     return out
 

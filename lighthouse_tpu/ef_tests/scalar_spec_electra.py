@@ -632,6 +632,50 @@ def verify_registry_updates_electra(pre, post) -> None:
             f"withdrawable[{i}]")
 
 
+def registry_updates_deneb(state) -> list[dict]:
+    """Pre-electra registry updates with the EIP-7514 activation-churn
+    cap: activations per epoch = min(MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT
+    = 4 on minimal, validator churn limit)."""
+    from .scalar_spec import active_indices, registry_updates, vrows
+    rows = registry_updates(state)          # altair semantics first
+    epoch = current_epoch(state)
+    orig = vrows(state)
+    churn = max(2, len(active_indices(orig, epoch)) // CHURN_QUOTIENT)
+    cap = min(4, churn)                     # minimal preset cap
+    fin = int(state.finalized_checkpoint.epoch)
+    queue = sorted(
+        (i for i, r in enumerate(orig)
+         if r["activation_eligibility_epoch"] <= fin
+         and r["activation_epoch"] == FAR_FUTURE),
+        key=lambda i: (orig[i]["activation_eligibility_epoch"], i))
+    for k, i in enumerate(queue):
+        rows[i]["activation_epoch"] = (
+            epoch + 1 + MAX_SEED_LOOKAHEAD if k < cap else FAR_FUTURE)
+    return rows
+
+
+def slashings_penalties_pre_electra(state, multiplier: int) -> list[int]:
+    """The pre-electra slashings formula (bellatrix/capella/deneb use
+    multiplier 3, altair 2): penalty = (eb // INC) * adjusted // total
+    * INC — integer-division order matters and differs from electra's
+    per-increment variant below."""
+    rows = vrows_full(state)
+    epoch = current_epoch(state)
+    total = total_active_balance(state)
+    adjusted = min(sum(int(s) for s in state.slashings) * multiplier,
+                   total)
+    target = epoch + 32                # EPOCHS_PER_SLASHINGS_VECTOR // 2
+    out = []
+    for i, r in enumerate(rows):
+        b = int(state.balances[i])
+        if r["slashed"] and r["withdrawable_epoch"] == target:
+            penalty = (r["effective_balance"] // INCREMENT) * adjusted \
+                // total * INCREMENT
+            b = max(0, b - penalty)
+        out.append(b)
+    return out
+
+
 def slashings_penalties_electra(state) -> list[int]:
     rows = vrows_full(state)
     epoch = current_epoch(state)
