@@ -1051,12 +1051,36 @@ class ApiBackend:
             getattr(svc, "deposits", [])))]
 
     def database_info(self) -> dict:
+        """database_manager-grade info over HTTP (lighthouse/database/info):
+        real schema version, hot/cold split point, and anchor."""
         store = self.chain.store
         anchor = store.backfill_anchor()
-        return {"schema_version": "1",
-                "split_slot": str(getattr(store, "split_slot", 0)),
-                "backfill_anchor_slot":
-                    str(anchor[0]) if anchor else None}
+        split = store.split
+        return {"schema_version": store.schema_version(),
+                "split": {"slot": str(split.slot),
+                          "state_root": "0x" + split.state_root.hex()},
+                "anchor": ({"anchor_slot": str(anchor[0])}
+                           if anchor else None)}
+
+    def nat_open(self) -> bool:
+        """/lighthouse/nat: a bare bool like the reference
+        (system_health observe_nat) — True unless a UPnP attempt ran
+        and failed to establish any mapping."""
+        out = getattr(self.chain, "nat_outcome", None)
+        return True if out is None else out.ok
+
+    def nat_status(self) -> dict:
+        """/lighthouse/nat/status (ours, beyond the reference): the
+        UPnP attempt's full outcome; a single stable shape whether or
+        not --upnp ran."""
+        out = getattr(self.chain, "nat_outcome", None)
+        if out is None:
+            return {"attempted": False, "gateway": None, "mapped": [],
+                    "error": None}
+        return {"attempted": out.attempted,
+                "gateway": out.gateway_location,
+                "mapped": [list(m) for m in out.mapped],
+                "error": out.error}
 
     def analysis_block_rewards(self, start_slot: int,
                                end_slot: int) -> list[dict]:

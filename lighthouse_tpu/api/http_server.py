@@ -361,7 +361,10 @@ def build_get_routes(backend: ApiBackend):
         (re.compile(r"^/lighthouse/analysis/block_rewards$"),
          lambda m, q: {"data": backend.analysis_block_rewards(
              int(q["start_slot"][0]), int(q["end_slot"][0]))}),
-        (re.compile(r"^/lighthouse/nat$"), lambda m, q: {"data": True}),
+        (re.compile(r"^/lighthouse/nat$"),
+         lambda m, q: {"data": backend.nat_open()}),
+        (re.compile(r"^/lighthouse/nat/status$"),
+         lambda m, q: {"data": backend.nat_status()}),
         (re.compile(r"^/lighthouse/ui/validator_count$"),
          lambda m, q: {"data": {"active_ongoing": len(
              backend.validators("head"))}}),

@@ -194,6 +194,7 @@ class ClientBuilder:
             from ..network.nat import establish_mappings
             client.nat = establish_mappings(client.network.port,
                                             client.discovery.disc.port)
+            client.chain.nat_outcome = client.nat   # /lighthouse/nat
         # advertise EXACTLY the attestation subnets the service
         # subscribed (all, or the two node-id-derived defaults) — an ENR
         # must not under/over-claim what the node serves (r5 review)

@@ -261,3 +261,26 @@ def test_blinded_block_get_route(api):
     fork = h.chain.spec.fork_name_at_slot(h.chain.head().head_state.slot)
     blk = deserialize(h.chain.T.SignedBeaconBlock[fork].ssz_type, raw)
     assert blk.message.slot == h.chain.head().head_state.slot
+
+
+def test_database_info_and_nat_status(api):
+    h, srv = api
+    d = _get(srv, "/lighthouse/database/info")["data"]
+    # schema_version is a NUMBER (reference DatabaseInfo u64 shape)
+    assert d["schema_version"] == h.chain.store.schema_version()
+    assert d["split"]["state_root"].startswith("0x")
+    # /lighthouse/nat stays a bare bool (reference observe_nat shape)
+    assert _get(srv, "/lighthouse/nat")["data"] is True
+    nat = _get(srv, "/lighthouse/nat/status")["data"]
+    assert nat == {"attempted": False, "gateway": None, "mapped": [],
+                   "error": None}
+    # with a UPnP outcome attached, both report the real result
+    from lighthouse_tpu.network.nat import NatOutcome
+    try:
+        h.chain.nat_outcome = NatOutcome(attempted=True,
+                                         mapped=[("TCP", 9000)])
+        assert _get(srv, "/lighthouse/nat")["data"] is True
+        nat2 = _get(srv, "/lighthouse/nat/status")["data"]
+        assert nat2["mapped"] == [["TCP", 9000]]
+    finally:
+        h.chain.nat_outcome = None
