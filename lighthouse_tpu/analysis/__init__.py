@@ -1,0 +1,20 @@
+"""graftlint — project-native static analysis.
+
+Mechanical enforcement of the invariants the hot paths and the concurrent
+service layer depend on (ISSUE 1; "Security Review of Ethereum Beacon
+Clients", PAPERS.md): trace-safety and recompile-freedom for the TPU
+kernels, lock/thread discipline for the beacon/network machinery, and
+drift-freedom for spec constants and SSZ schemas.
+
+Entry points:
+- ``python tools/lint/run.py`` — the CLI (text/JSON reports, baseline).
+- :func:`lighthouse_tpu.analysis.engine.run_project` — library API.
+
+Rules live in :mod:`lighthouse_tpu.analysis.rules`; each is documented in
+ANALYSIS.md. The suite is pure-AST (no jax import) so it runs in seconds
+on CPU.
+"""
+from .engine import (  # noqa: F401
+    Project, Rule, Violation, all_rules, load_baseline, run_project,
+)
+from . import rules  # noqa: F401  (imports register every rule)

@@ -1,0 +1,270 @@
+"""graftlint engine: rule registry, project model, baseline, reporters.
+
+Deliberately dependency-free (ast + json + pathlib only): the lint suite
+must run in seconds on a CPU-only container and inside tier-1 without
+touching jax. Rules register themselves via the :func:`rule` decorator at
+import time (``analysis/rules/__init__.py`` imports each rule module).
+
+Baseline discipline: ``baseline.json`` is a *reviewed* allowlist. Every
+entry must carry a non-empty ``justification`` and match at least one
+live violation — stale entries are reported so the allowlist cannot rot
+into a dumping ground (the failure mode the beacon-client security
+review attributes most silent-invariant bugs to).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import time
+from pathlib import Path
+
+#: directories never scanned (generated corpora, caches)
+_SKIP_PARTS = {"__pycache__", ".jax_cache", ".git"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding. ``symbol`` is the enclosing def/class chain — it keys
+    baseline matching so entries survive unrelated line drift."""
+    rule: str
+    path: str            # path relative to the scan root's parent
+    line: int
+    message: str
+    symbol: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message}
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{loc}: {self.rule}{sym}: {self.message}"
+
+
+class Module:
+    """One parsed source file."""
+
+    def __init__(self, path: Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source, filename=str(path))
+
+    def violation(self, rule: str, node: ast.AST, message: str,
+                  symbol: str = "") -> Violation:
+        return Violation(rule=rule, path=self.relpath,
+                         line=getattr(node, "lineno", 0),
+                         message=message, symbol=symbol)
+
+
+class Project:
+    """The scanned file set plus the package root (for rules that need
+    out-of-scan context, e.g. the spec-constant table)."""
+
+    def __init__(self, root: Path, modules: list[Module]):
+        self.root = root
+        self.modules = modules
+
+    @classmethod
+    def load(cls, root: Path, paths: list[Path] | None = None) -> "Project":
+        root = root.resolve()
+        files: list[Path] = []
+        for base in (paths or [root]):
+            base = base.resolve()
+            if base.is_file():
+                files.append(base)
+            else:
+                files.extend(sorted(base.rglob("*.py")))
+        modules = []
+        for f in files:
+            if _SKIP_PARTS.intersection(f.parts):
+                continue
+            try:
+                rel = str(f.relative_to(root.parent))
+            except ValueError:
+                rel = str(f)
+            modules.append(Module(f, rel, f.read_text()))
+        return cls(root, modules)
+
+
+class Rule:
+    """Base rule. Subclasses set ``name``/``description`` and override
+    :meth:`check_module` (per file) and/or :meth:`finalize` (cross-file,
+    called once after every module was seen)."""
+
+    name: str = ""
+    description: str = ""
+
+    def check_module(self, module: Module,
+                     project: Project) -> list[Violation]:
+        return []
+
+    def finalize(self, project: Project) -> list[Violation]:
+        return []
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def rule(cls: type[Rule]) -> type[Rule]:
+    """Class decorator: instantiate and register a rule by its name."""
+    inst = cls()
+    assert inst.name, f"{cls.__name__} has no name"
+    assert inst.name not in _REGISTRY, f"duplicate rule {inst.name}"
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    return dict(_REGISTRY)
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: Path) -> list[dict]:
+    """Load and validate the allowlist; every entry needs rule, path and a
+    non-empty justification (reviewed, not silently accumulated)."""
+    if not path.exists():
+        return []
+    entries = json.loads(path.read_text())
+    for e in entries:
+        for field in ("rule", "path", "justification"):
+            if not e.get(field):
+                raise ValueError(
+                    f"baseline entry {e!r} missing required {field!r}")
+    return entries
+
+
+def _baseline_matches(entry: dict, v: Violation) -> bool:
+    if entry["rule"] != v.rule or entry["path"] != v.path:
+        return False
+    if "symbol" in entry:
+        return entry["symbol"] == v.symbol
+    if "line" in entry:
+        return int(entry["line"]) == v.line
+    return True          # whole-file waiver for this rule
+
+
+# -- driver ------------------------------------------------------------------
+
+def run_project(project: Project, rules: dict[str, Rule] | None = None,
+                baseline: list[dict] | None = None) -> dict:
+    """Run rules over the project. Returns a report dict:
+    ``violations`` (non-baselined), ``baselined``, ``stale_baseline``
+    (entries that matched nothing), ``elapsed_s``."""
+    rules = rules if rules is not None else all_rules()
+    baseline = baseline or []
+    t0 = time.monotonic()
+    found: list[Violation] = []
+    for r in rules.values():
+        for mod in project.modules:
+            found.extend(r.check_module(mod, project))
+        found.extend(r.finalize(project))
+    live, waived = [], []
+    used = [False] * len(baseline)
+    for v in found:
+        matched = False
+        for i, e in enumerate(baseline):
+            if _baseline_matches(e, v):
+                used[i] = True
+                matched = True
+        (waived if matched else live).append(v)
+    live.sort(key=lambda v: (v.path, v.line, v.rule))
+    waived.sort(key=lambda v: (v.path, v.line, v.rule))
+    return {
+        "violations": live,
+        "baselined": waived,
+        "stale_baseline": [e for i, e in enumerate(baseline) if not used[i]],
+        "rules": sorted(rules),
+        "files": len(project.modules),
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+
+
+def render_text(report: dict) -> str:
+    lines = []
+    for v in report["violations"]:
+        lines.append(v.render())
+    for v in report["baselined"]:
+        lines.append(f"{v.render()}  (baselined)")
+    for e in report["stale_baseline"]:
+        lines.append(f"WARNING: stale baseline entry matches nothing: "
+                     f"{json.dumps(e, sort_keys=True)}")
+    lines.append(
+        f"graftlint: {len(report['violations'])} violation(s), "
+        f"{len(report['baselined'])} baselined, "
+        f"{len(report['stale_baseline'])} stale baseline entr(ies) — "
+        f"{len(report['rules'])} rules over {report['files']} files in "
+        f"{report['elapsed_s']}s")
+    return "\n".join(lines)
+
+
+def render_json(report: dict) -> str:
+    return json.dumps({
+        "violations": [v.to_json() for v in report["violations"]],
+        "baselined": [v.to_json() for v in report["baselined"]],
+        "stale_baseline": report["stale_baseline"],
+        "rules": report["rules"],
+        "files": report["files"],
+        "elapsed_s": report["elapsed_s"],
+    }, indent=2)
+
+
+# -- shared AST helpers (used by several rules) ------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.jit' for Attribute/Name chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def enclosing_symbol(stack: list[ast.AST]) -> str:
+    """Dotted def/class chain for a node stack, e.g. 'Peer.close'."""
+    names = [n.name for n in stack
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))]
+    return ".".join(names)
+
+
+def safe_int_eval(node: ast.AST) -> int | None:
+    """Evaluate a constant integer expression (literals, + - * ** << |,
+    unary -). Returns None for anything non-constant. Lets the drift rule
+    see through forms like ``2**64 - 1``."""
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, int) \
+            and not isinstance(node.value, bool) else None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = safe_int_eval(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = safe_int_eval(node.left), safe_int_eval(node.right)
+        if lhs is None or rhs is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return lhs + rhs
+            if isinstance(node.op, ast.Sub):
+                return lhs - rhs
+            if isinstance(node.op, ast.Mult):
+                return lhs * rhs
+            if isinstance(node.op, ast.Pow):
+                return lhs ** rhs if abs(rhs) < 512 else None
+            if isinstance(node.op, ast.LShift):
+                return lhs << rhs if rhs < 512 else None
+            if isinstance(node.op, ast.BitOr):
+                return lhs | rhs
+        except (OverflowError, ValueError):
+            return None
+    return None

@@ -1,0 +1,9 @@
+"""Rule modules register themselves with the engine on import."""
+from . import (  # noqa: F401
+    lock_discipline,
+    recompilation,
+    spec_constants,
+    ssz_schema,
+    thread_lifecycle,
+    trace_safety,
+)

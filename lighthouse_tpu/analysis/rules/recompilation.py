@@ -1,0 +1,183 @@
+"""recompile-hazard: jit usage patterns that defeat the trace cache.
+
+Three hazard classes, each a real recompile-per-call (or
+retrace-per-call) on TPU:
+
+1. ``jax.jit(f)(x)`` / ``jax.jit(shard_map(f, ...))(x)`` built inside a
+   function body — the wrapper (and its trace cache) is rebuilt on every
+   call, so every call re-traces. shard_map closures are the worst case:
+   the inner callable itself is fresh each time. Wrap once at module
+   level or memoize the wrapped callable.
+2. jit'd callables whose parameters default to (or are annotated as) raw
+   Python ``list``/``dict``/``set`` — unhashable as static args, and as
+   traced args every distinct length recompiles.
+3. ``static_argnums``/``static_argnames`` pointing at parameters whose
+   annotation/default is unhashable (``list``/``dict``/``set``) —
+   TypeError at call time, or silent per-call retraces when the caller
+   converts ad hoc.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Module, Project, Rule, dotted_name, enclosing_symbol, rule
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pmap", "pmap"}
+_WRAP_NAMES = _JIT_NAMES | {"shard_map", "jax.shard_map",
+                            "jax.experimental.shard_map.shard_map"}
+_UNHASHABLE_ANN = {"list", "dict", "set", "List", "Dict", "Set"}
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) in _JIT_NAMES
+
+
+def _unhashable_annotation(ann: ast.AST | None) -> bool:
+    if ann is None:
+        return False
+    name = dotted_name(ann)
+    if name in _UNHASHABLE_ANN:
+        return True
+    if isinstance(ann, ast.Subscript):      # list[int], typing.List[int]
+        return dotted_name(ann.value) in _UNHASHABLE_ANN
+    return False
+
+
+def _mutable_literal(node: ast.AST | None) -> bool:
+    return isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp))
+
+
+def _jit_decorator(fn: ast.FunctionDef) -> ast.AST | None:
+    for dec in fn.decorator_list:
+        if dotted_name(dec) in _JIT_NAMES:
+            return dec
+        if isinstance(dec, ast.Call):
+            if dotted_name(dec.func) in _JIT_NAMES:
+                return dec
+            if dotted_name(dec.func).endswith("partial") and dec.args and \
+                    dotted_name(dec.args[0]) in _JIT_NAMES:
+                return dec
+    return None
+
+
+def _static_argnums(dec: ast.AST) -> tuple[list[int], list[str]]:
+    nums: list[int] = []
+    names: list[str] = []
+    if not isinstance(dec, ast.Call):
+        return nums, names
+    for kw in dec.keywords:
+        if kw.arg == "static_argnums":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, int):
+                    nums.append(sub.value)
+        elif kw.arg == "static_argnames":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, str):
+                    names.append(sub.value)
+    return nums, names
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule_name: str, mod: Module):
+        self.rule_name = rule_name
+        self.mod = mod
+        self.stack: list[ast.AST] = []
+        self.violations: list = []
+        self.visit(mod.tree)
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        self.violations.append(self.mod.violation(
+            self.rule_name, node, message,
+            symbol=enclosing_symbol(self.stack)))
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        dec = _jit_decorator(node)
+        if dec is not None:
+            self._check_signature(node, dec)
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_signature(self, fn: ast.FunctionDef, dec: ast.AST) -> None:
+        args = fn.args.posonlyargs + fn.args.args
+        qual = enclosing_symbol(self.stack + [fn])
+        if args and args[0].arg == "self" and any(
+                isinstance(s, ast.ClassDef) for s in self.stack):
+            self._flag(fn, "@jit on a method traces through `self`: every "
+                           "instance (and every mutated attribute) "
+                           "recompiles — jit a free function or use "
+                           "functools.partial at call sites")
+        defaults = fn.args.defaults
+        offset = len(args) - len(defaults)
+        nums, names = _static_argnums(dec)
+        for i, a in enumerate(args):
+            default = defaults[i - offset] if i >= offset else None
+            is_static = i in nums or a.arg in names
+            if _unhashable_annotation(a.annotation) or \
+                    _mutable_literal(default):
+                if is_static:
+                    self._flag(a, f"static arg '{a.arg}' of jit'd "
+                                  f"'{qual}' is unhashable "
+                                  "(list/dict/set) — static args must "
+                                  "hash; use a tuple or hoist it")
+                else:
+                    self._flag(a, f"jit'd '{qual}' takes raw Python "
+                                  f"'{a.arg}' (list/dict) — every length "
+                                  "is a fresh trace; pass an array or "
+                                  "mark it static with a hashable type")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # jax.jit(...) evaluated inside a function body — the wrapper
+        # (and its trace cache) is rebuilt on every execution of that
+        # function, so every call re-traces. Module-level wrapping runs
+        # once at import (the idiom), and a memoized factory
+        # (@functools.lru_cache/@cache) is the sanctioned way to build
+        # per-mesh/per-shape wrappers.
+        if _is_jit_call(node) and self._in_function() and \
+                not self._enclosing_memoized():
+            inner = ""
+            target = node.args[0] if node.args else None
+            if isinstance(target, ast.Call) and \
+                    dotted_name(target.func) in _WRAP_NAMES:
+                inner = " (worse: the shard_map closure inside is also " \
+                        "fresh each call)"
+            self._flag(node, "jit wrapper built inside a function body — "
+                             "the trace cache dies with the wrapper, so "
+                             "every call re-traces; build it once at "
+                             "module level or in an @lru_cache factory"
+                             + inner)
+        self.generic_visit(node)
+
+    def _in_function(self) -> bool:
+        return any(isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   for s in self.stack)
+
+    def _enclosing_memoized(self) -> bool:
+        for s in self.stack:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in s.decorator_list:
+                    name = dotted_name(dec if not isinstance(dec, ast.Call)
+                                       else dec.func)
+                    if name.split(".")[-1] in ("lru_cache", "cache"):
+                        return True
+        return False
+
+
+@rule
+class RecompilationRule(Rule):
+    name = "recompile-hazard"
+    description = ("jit wrappers rebuilt per call, unhashable static "
+                   "args, raw list/dict params of jit'd callables")
+
+    def check_module(self, module: Module, project: Project) -> list:
+        return _Visitor(self.name, module).violations

@@ -1,0 +1,354 @@
+"""trace-safety: no host syncs or Python side effects inside jit traces.
+
+A host sync (``.item()``, ``np.asarray`` on a traced value, ``float()``
+on a tracer, ``block_until_ready``) inside a ``@jax.jit``/``pmap``/
+``shard_map``-reachable function either crashes at trace time or — worse
+— silently forces a device round-trip per call, which is exactly the
+recompile/round-trip class of regression the merkleization pipeline
+(FAFO's single-node result, PAPER.md) cannot afford. Python side effects
+(``print``, mutating closure state, ``time.time()``) run once at trace
+time and then never again, so they are latent logic bugs.
+
+Mechanics:
+1. jit roots: functions decorated with / passed to ``jax.jit``,
+   ``jax.pmap`` or ``shard_map``.
+2. reachability: call edges between scanned functions (same module by
+   name, cross-module through ``from X import name``), BFS from roots.
+3. a per-function taint pass marks values derived from parameters as
+   traced; ``.shape``/``.ndim``/``.dtype`` access launders taint (those
+   are static Python values under tracing — the classic true negative).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Module, Project, Rule, dotted_name, rule
+
+_JIT_WRAPPERS = {"jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
+                 "jax.shard_map", "jax.experimental.shard_map.shard_map"}
+#: attribute calls that force a device->host sync
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+#: numpy entry points that pull a traced value to the host
+_NP_FUNCS = {"np.asarray", "np.array", "np.frombuffer", "numpy.asarray",
+             "numpy.array", "onp.asarray", "onp.array"}
+_HOST_CASTS = {"float", "int", "bool"}
+#: impure calls that burn into the trace once and never re-run
+_IMPURE_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+                 "time.sleep", "jax.device_get"}
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popleft", "appendleft"}
+#: attribute access that yields a *static* Python value on a tracer
+_TAINT_LAUNDER = {"shape", "ndim", "dtype"}
+#: calls that REQUIRE a concrete int — using one proves the value is
+#: static at trace time (a tracer would already have raised), so data
+#: derived through them is host data, not a sync
+_CONCRETIZERS = {"bin", "hex", "oct", "len", "range"}
+
+
+def _func_key(mod: Module, qualname: str) -> tuple[str, str]:
+    return (mod.relpath, qualname)
+
+
+class _FuncIndex(ast.NodeVisitor):
+    """Collect every function in a module by qualified name, plus which
+    are jit roots and the names each body calls."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.stack: list[str] = []
+        self.funcs: dict[str, ast.FunctionDef] = {}
+        self.roots: set[str] = set()
+        # decorator-less names wrapped at call sites: jax.jit(fn), ...
+        # recorded with the scope the wrap happened in, so `jit(update)`
+        # inside a factory doesn't taint every method named `update`
+        self._wrapped_names: set[tuple[str, str]] = set()
+        self.visit(mod.tree)
+        for prefix, name in self._wrapped_names:
+            scoped = f"{prefix}.{name}" if prefix else name
+            if scoped in self.funcs:
+                self.roots.add(scoped)
+            elif name in self.funcs:    # module-level fn wrapped elsewhere
+                self.roots.add(name)
+
+    def _qual(self, name: str) -> str:
+        return ".".join(self.stack + [name])
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        qn = self._qual(node.name)
+        self.funcs[qn] = node
+        for dec in node.decorator_list:
+            dn = dotted_name(dec)
+            if dn in _JIT_WRAPPERS:
+                self.roots.add(qn)
+            elif isinstance(dec, ast.Call):
+                # @functools.partial(jax.jit, ...) / @jax.jit(...)
+                if dotted_name(dec.func) in _JIT_WRAPPERS:
+                    self.roots.add(qn)
+                elif dotted_name(dec.func).endswith("partial") and dec.args \
+                        and dotted_name(dec.args[0]) in _JIT_WRAPPERS:
+                    self.roots.add(qn)
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = dotted_name(node.func)
+        if fn in _JIT_WRAPPERS:
+            for arg in node.args[:1]:
+                target = arg
+                # jax.jit(functools.partial(f, ...)) / partial chains
+                if isinstance(target, ast.Call) and target.args:
+                    target = target.args[0]
+                name = dotted_name(target)
+                if name:
+                    self._wrapped_names.add((".".join(self.stack),
+                                             name.split(".")[-1]))
+        self.generic_visit(node)
+
+
+#: higher-order callables whose *arguments* are traced as functions
+_HIGHER_ORDER = {"scan", "fori_loop", "while_loop", "cond", "switch",
+                 "map", "associative_scan", "vmap", "checkpoint", "remat",
+                 "custom_jvp", "custom_vjp", "partial"} | \
+    {n.split(".")[-1] for n in _JIT_WRAPPERS}
+
+
+def _called_names(fn: ast.FunctionDef) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name:
+                out.add(name)
+            # callables passed into higher-order primitives only (scan
+            # bodies, cond branches) — a plain data argument must not
+            # become a call edge
+            if name.split(".")[-1] in _HIGHER_ORDER:
+                for arg in node.args:
+                    an = dotted_name(arg)
+                    if an:
+                        out.add(an)
+    return out
+
+
+def _imports(mod: Module) -> dict[str, tuple[str, str]]:
+    """local name -> (module dotted path, original name) for
+    ``from X import name`` statements."""
+    out = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module is not None:
+            for alias in node.names:
+                out[alias.asname or alias.name] = (node.module, alias.name)
+    return out
+
+
+def _module_by_suffix(project: Project, dotted: str) -> Module | None:
+    """Resolve 'lighthouse_tpu.ops.bls12_381' / '..ops.bls12_381' to a
+    scanned module by path suffix (relative dots already stripped)."""
+    suffix = dotted.replace(".", "/") + ".py"
+    for m in project.modules:
+        if m.relpath.endswith(suffix):
+            return m
+    return None
+
+
+class _TaintChecker(ast.NodeVisitor):
+    """Scan one jit-reachable function with parameter taint."""
+
+    def __init__(self, rule_name: str, mod: Module, qualname: str,
+                 fn: ast.FunctionDef):
+        self.rule_name = rule_name
+        self.mod = mod
+        self.qualname = qualname
+        self.fn = fn
+        args = fn.args
+        params = [a.arg for a in
+                  args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            params.append(args.vararg.arg)
+        if args.kwarg:
+            params.append(args.kwarg.arg)
+        self.tainted = {p for p in params if p not in ("self", "cls")}
+        self.local_names = set(self.tainted)
+        self.violations = []
+        # two passes: settle assignments first so use-before-def within
+        # loops still sees the taint
+        for _ in range(2):
+            for stmt in fn.body:
+                self._collect_assigns(stmt)
+        for stmt in fn.body:
+            self.visit(stmt)
+
+    # -- taint propagation ---------------------------------------------------
+
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr in _TAINT_LAUNDER:
+                # .shape/.ndim/.dtype are static: prune by checking the
+                # attribute chain textually instead of descending
+                continue
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                if self._laundered(node, sub):
+                    continue
+                return True
+        return False
+
+    def _laundered(self, root: ast.AST, name: ast.Name) -> bool:
+        """True if the use of `name` inside `root` goes through a
+        .shape/.ndim/.dtype access or a concretizing call (bin/len/...),
+        both of which yield static host values under tracing."""
+        for sub in ast.walk(root):
+            if isinstance(sub, ast.Attribute) and sub.attr in _TAINT_LAUNDER:
+                if any(s is name for s in ast.walk(sub.value)):
+                    return True
+            if isinstance(sub, ast.Call) and \
+                    dotted_name(sub.func) in _CONCRETIZERS:
+                if any(s is name for s in ast.walk(sub)):
+                    return True
+        return False
+
+    def _collect_assigns(self, stmt: ast.AST) -> None:
+        for node in ast.walk(stmt):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                    and node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.For):
+                targets, value = [node.target], node.iter
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.local_names.add(node.name)
+                continue
+            else:
+                continue
+            names = [n.id for t in targets for n in ast.walk(t)
+                     if isinstance(n, ast.Name)]
+            self.local_names.update(names)
+            if self._expr_tainted(value):
+                self.tainted.update(names)
+
+    # -- checks --------------------------------------------------------------
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        self.violations.append(self.mod.violation(
+            self.rule_name, node, message, symbol=self.qualname))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fname = dotted_name(node.func)
+        if fname == "print":
+            self._flag(node, "print() inside a jit-reachable function "
+                             "runs only at trace time — use "
+                             "jax.debug.print or drop it")
+        elif fname in _IMPURE_CALLS:
+            self._flag(node, f"{fname}() inside a jit-reachable function "
+                             "is evaluated once at trace time (impure "
+                             "trace) — hoist it to the caller")
+        elif fname in _NP_FUNCS:
+            if node.args and self._expr_tainted(node.args[0]):
+                self._flag(node, f"{fname}() on a traced value forces a "
+                                 "device->host sync — use jnp.asarray or "
+                                 "hoist the conversion out of the jit")
+        elif fname in _HOST_CASTS:
+            if node.args and self._expr_tainted(node.args[0]):
+                self._flag(node, f"{fname}() on a traced value is a host "
+                                 "sync (ConcretizationError under jit) — "
+                                 "keep it on device or hoist it")
+        elif isinstance(node.func, ast.Attribute):
+            if node.func.attr in _SYNC_METHODS and \
+                    self._expr_tainted(node.func.value):
+                self._flag(node, f".{node.func.attr}() on a traced value "
+                                 "is a device->host sync inside the trace")
+            elif node.func.attr in _MUTATORS and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id not in self.local_names:
+                self._flag(node, f"mutating closure/global "
+                                 f"'{node.func.value.id}."
+                                 f"{node.func.attr}()' inside a "
+                                 "jit-reachable function runs only at "
+                                 "trace time — return the value instead")
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._flag(node, "writing globals inside a jit-reachable function "
+                         "is a trace-time-only side effect")
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs are visited through the call graph if jit-reachable
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+@rule
+class TraceSafetyRule(Rule):
+    name = "trace-safety"
+    description = ("host syncs and Python side effects inside "
+                   "jit/pmap/shard_map-reachable functions")
+
+    def finalize(self, project: Project) -> list:
+        indexes = {m.relpath: _FuncIndex(m) for m in project.modules}
+        imports = {m.relpath: _imports(m) for m in project.modules}
+        mods = {m.relpath: m for m in project.modules}
+
+        # BFS over (module, qualname) from jit roots
+        work = [(rel, qn) for rel, idx in indexes.items()
+                for qn in idx.roots]
+        reachable = set(work)
+        while work:
+            rel, qn = work.pop()
+            fn = indexes[rel].funcs.get(qn)
+            if fn is None:
+                continue
+            for called in _called_names(fn):
+                base = called.split(".")[-1] if "." not in called \
+                    else None
+                cands: list[tuple[str, str]] = []
+                # same-module resolution (plain or Class.method names)
+                if "." not in called:
+                    cands += [(rel, q) for q in indexes[rel].funcs
+                              if q == called or q.endswith("." + called)]
+                    # cross-module via from-imports
+                    imp = imports[rel].get(called)
+                    if imp is not None:
+                        target = _module_by_suffix(project,
+                                                   imp[0].lstrip("."))
+                        if target is not None:
+                            tq = imp[1]
+                            if tq in indexes[target.relpath].funcs:
+                                cands.append((target.relpath, tq))
+                else:
+                    # module-attribute calls: bi.mont_mul, k.g1_scalar_mul
+                    prefix, attr = called.rsplit(".", 1)
+                    imp = imports[rel].get(prefix)
+                    mod_path = None
+                    if imp is not None:
+                        mod_path = (imp[0].lstrip(".") + "." + imp[1]) \
+                            .lstrip(".")
+                    else:
+                        mod_path = prefix
+                    target = _module_by_suffix(project, mod_path)
+                    if target is not None and \
+                            attr in indexes[target.relpath].funcs:
+                        cands.append((target.relpath, attr))
+                for cand in cands:
+                    if cand not in reachable:
+                        reachable.add(cand)
+                        work.append(cand)
+
+        out = []
+        for rel, qn in sorted(reachable):
+            fn = indexes[rel].funcs.get(qn)
+            if fn is None:
+                continue
+            checker = _TaintChecker(self.name, mods[rel], qn, fn)
+            out.extend(checker.violations)
+        return out

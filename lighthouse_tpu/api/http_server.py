@@ -82,6 +82,9 @@ class BeaconApiServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
 
 
 # POST/DELETE paths served by do_POST below (kept as data for the route

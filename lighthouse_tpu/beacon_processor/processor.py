@@ -66,6 +66,7 @@ class BeaconProcessor:
                  batch_handler: Callable | None = None,
                  aggregate_batch_handler: Callable | None = None):
         from .reprocess import ReprocessQueue
+        from ..utils.threads import ThreadGroup
         self.queues: dict[WorkType, deque] = {w: deque() for w in WorkType}
         self.reprocess = ReprocessQueue(self.submit)
         self.caps = dict(DEFAULT_CAPS)
@@ -76,16 +77,26 @@ class BeaconProcessor:
         self._event = threading.Event()
         self._stop = False
         self.num_workers = num_workers
-        self._manager = threading.Thread(target=self._run, daemon=True)
+        self._workers = ThreadGroup("beacon_processor")
+        self._manager = threading.Thread(target=self._run, daemon=True,
+                                         name="beacon_processor.manager")
         self.dropped = 0
         self.processed = 0
 
     def start(self) -> None:
         self._manager.start()
 
-    def stop(self) -> None:
+    def stop(self, join: bool = True) -> None:
+        """Stop the manager loop; by default JOIN it and the in-flight
+        workers so no processor thread outlives the chain/network it
+        touches (clean-shutdown discipline, task_executor/src/lib.rs)."""
         self._stop = True
         self._event.set()
+        if join:
+            if self._manager.is_alive() and \
+                    self._manager is not threading.current_thread():
+                self._manager.join(timeout=2)
+            self._workers.join_all(timeout=2)
 
     def submit(self, work: Work) -> bool:
         with self._lock:
@@ -122,8 +133,8 @@ class BeaconProcessor:
                 self._event.clear()
                 continue
             self._idle.acquire()
-            threading.Thread(target=self._execute, args=(work,),
-                             daemon=True).start()
+            self._workers.spawn(self._execute, work,
+                                name="beacon_processor.worker")
 
     def _execute(self, work) -> None:
         try:
@@ -145,7 +156,8 @@ class BeaconProcessor:
                 else:
                     for w in work:
                         w.run()
-                self.processed += len(work)
+                with self._lock:
+                    self.processed += len(work)
             else:
                 handler = (self.batch_handler
                            if work.kind == WorkType.GOSSIP_ATTESTATION
@@ -159,7 +171,8 @@ class BeaconProcessor:
                     handler([work.batchable_payload])
                 else:
                     work.run()
-                self.processed += 1
+                with self._lock:
+                    self.processed += 1
         except Exception:
             import logging
             logging.getLogger("lighthouse_tpu.processor").exception(

@@ -49,7 +49,8 @@ class ReprocessQueue:
         memory (each distinct slot would otherwise open a fresh bucket)."""
         if current_slot is not None and \
                 slot > current_slot + self.MAX_FUTURE_SLOTS:
-            self.refused_total += 1
+            with self._lock:
+                self.refused_total += 1
             return
         with self._lock:
             bucket = self._by_slot[slot]
@@ -87,7 +88,8 @@ class ReprocessQueue:
                     self._by_root_count -= len(bucket)
         for w in due:
             self._submit(w)
-        self.replayed_total += len(due)
+        with self._lock:
+            self.replayed_total += len(due)
         return len(due)
 
     def on_block_imported(self, block_root: bytes) -> int:
@@ -96,7 +98,8 @@ class ReprocessQueue:
             self._by_root_count -= len(due)
         for w in due:
             self._submit(w)
-        self.replayed_total += len(due)
+        with self._lock:
+            self.replayed_total += len(due)
         return len(due)
 
     @property

@@ -11,7 +11,10 @@ import functools
 from types import SimpleNamespace
 
 from ..specs.chain_spec import ForkName
-from ..specs.constants import DEPOSIT_CONTRACT_TREE_DEPTH, JUSTIFICATION_BITS_LENGTH
+from ..specs.constants import (
+    BYTES_PER_FIELD_ELEMENT, DEPOSIT_CONTRACT_TREE_DEPTH,
+    JUSTIFICATION_BITS_LENGTH, SYNC_COMMITTEE_SUBNET_COUNT,
+)
 from ..specs.presets import Preset
 from ..ssz import (
     Bitlist, Bitvector, ByteList, ByteVector, Bytes4, Bytes20, Bytes32,
@@ -207,7 +210,8 @@ def _build_types(p: Preset) -> Types:
         slot: uint64
         beacon_block_root: Root
         subcommittee_index: uint64
-        aggregation_bits: Bitvector(p.sync_committee_size // 4)
+        aggregation_bits: Bitvector(p.sync_committee_size
+                                    // SYNC_COMMITTEE_SUBNET_COUNT)
         signature: Bytes96
 
     @container
@@ -489,7 +493,7 @@ def _build_types(p: Preset) -> Types:
         signature: Bytes96
 
     # -- deneb blobs ---------------------------------------------------------
-    Blob = ByteVector(32 * p.field_elements_per_blob)
+    Blob = ByteVector(BYTES_PER_FIELD_ELEMENT * p.field_elements_per_blob)
 
     @container
     class BlobSidecar:

@@ -29,9 +29,9 @@ from .bls12_381 import (
 )
 from .bls12_381.curve import B_G1, Point
 from .bls12_381.fields import R
+from ..specs.constants import BYTES_PER_FIELD_ELEMENT  # single source of truth
 
 FIELD_ELEMENTS_PER_BLOB = 4096
-BYTES_PER_FIELD_ELEMENT = 32
 #: spec cell count over the 2x-extended blob (CELLS_PER_EXT_BLOB); clamped
 #: to the extended domain size for small devnet setups
 CELLS_PER_EXT_BLOB = 128

@@ -82,7 +82,10 @@ class Eth1Service:
         from .deposit_snapshot import DepositTree
         self.deposit_tree_4881 = DepositTree()
         self._pending_4881_finalize: tuple | None = None
-        self._lock = threading.Lock()
+        # RLock: update()/finalize() call helper methods that take the
+        # lock themselves, so every _pending_4881_finalize access is
+        # visibly guarded (graftlint: lock-discipline)
+        self._lock = threading.RLock()
 
     # -- finalization pruning (eth1_finalization_cache.rs consumer) ----------
 
@@ -131,15 +134,17 @@ class Eth1Service:
         block is recomputed NOW — the one cached at finalize() time
         predated the logs and would make resuming nodes re-scan deposits
         already inside the finalized prefix (r5 review)."""
-        pending = self._pending_4881_finalize
-        if pending is None or pending[0] > self.deposit_tree_4881.count:
-            return
-        count, fin_block = pending
-        for b in self.block_cache:
-            if b.deposit_count <= count:
-                fin_block = (b.hash, b.number)
-        self.deposit_tree_4881.finalize(count, fin_block[0], fin_block[1])
-        self._pending_4881_finalize = None
+        with self._lock:
+            pending = self._pending_4881_finalize
+            if pending is None or pending[0] > self.deposit_tree_4881.count:
+                return
+            count, fin_block = pending
+            for b in self.block_cache:
+                if b.deposit_count <= count:
+                    fin_block = (b.hash, b.number)
+            self.deposit_tree_4881.finalize(count, fin_block[0],
+                                            fin_block[1])
+            self._pending_4881_finalize = None
 
     def get_deposit_snapshot(self):
         """The resumable EIP-4881 snapshot (http_api get_deposit_snapshot)."""

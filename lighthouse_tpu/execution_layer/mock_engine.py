@@ -51,12 +51,16 @@ class MockEngineServer:
         self.port = self.httpd.server_address[1]
 
     def start(self):
-        threading.Thread(target=self.httpd.serve_forever,
-                         daemon=True).start()
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
 
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        if getattr(self, "_thread", None) is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
 
     def _status_for(self, block_hash: str) -> str:
         if self.static_response:

@@ -22,6 +22,7 @@ import threading
 
 from cryptography.exceptions import InvalidTag
 
+from ..utils.threads import ThreadGroup
 from . import discv5_wire as wire
 from . import rlp, secp256k1
 from .enr import Enr, EnrError
@@ -191,6 +192,7 @@ class Discv5:
         self._lock = threading.Lock()
         self._running = False
         self._thread = None
+        self._threads = ThreadGroup("discv5")
         self.bootnodes = list(bootnodes or [])
         for b in self.bootnodes:
             self.table.update(b)
@@ -210,6 +212,7 @@ class Discv5:
         self._running = False
         if self._thread:
             self._thread.join(timeout=2)
+        self._threads.join_all(timeout=2)
         self.sock.close()
 
     # -- packet pump ---------------------------------------------------------
@@ -364,8 +367,8 @@ class Discv5:
                 # the peer advertises a newer record: re-fetch it
                 # (FINDNODE distance 0 returns the local ENR) off-thread —
                 # the recv loop must not block on its own request
-                threading.Thread(target=self._refresh_enr, args=(enr,),
-                                 daemon=True).start()
+                self._threads.spawn(self._refresh_enr, enr,
+                                    name="discv5.refresh_enr")
             self._reply(addr, wire.enc_pong(req_id, self.local_enr.seq,
                                             addr[0], addr[1]))
         elif t == wire.MSG_FINDNODE:

@@ -17,7 +17,9 @@ simulator drive them synchronously): `maybe_sync()`, `backfill()`,
 from __future__ import annotations
 
 import threading
-from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from concurrent.futures import (
+    FIRST_COMPLETED, Future, ThreadPoolExecutor, wait,
+)
 
 from ...chain.errors import BlockError
 from ...ssz import deserialize, htr, serialize
@@ -40,6 +42,7 @@ class _RealSyncContext:
         self._digest_map = None
         self._next_req = 0
         self._pool = None
+        self._closed = False
         # req_id -> (owner, peer_id, future, kind)
         self.inflight: dict[int, tuple] = {}
         self.imported_total = 0
@@ -69,7 +72,8 @@ class _RealSyncContext:
             n = self.chain.process_chain_segment(blocks)
         except BlockError as e:
             return 0, e.kind
-        self.imported_total += n
+        with self._lock:
+            self.imported_total += n
         return n, None
 
     def penalize(self, peer_id: str, reason: str) -> None:
@@ -106,6 +110,32 @@ class _RealSyncContext:
             self._pool = ThreadPoolExecutor(max_workers=self.MAX_WORKERS)
         return self._pool
 
+    def close(self) -> None:
+        """Shutdown path (task_executor/src/lib.rs:12-28 ordering): no
+        new downloads may be submitted once closed — late callers get an
+        already-failed future instead of `RuntimeError: cannot schedule
+        new futures after shutdown` escaping on a status-exchange thread
+        (the round-5 leak)."""
+        with self._lock:
+            self._closed = True
+            pool = self._pool
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def _submit(self, fn, *args) -> Future:
+        with self._lock:
+            if self._closed:
+                fut: Future = Future()
+                fut.set_exception(TimeoutError("sync context closed"))
+                return fut
+            pool = self._executor()
+        try:
+            return pool.submit(fn, *args)
+        except RuntimeError:            # raced an interpreter-level shutdown
+            fut = Future()
+            fut.set_exception(TimeoutError("sync context closed"))
+            return fut
+
     def _decode_block(self, hex_payload: str):
         try:
             raw = bytes.fromhex(hex_payload)
@@ -140,8 +170,7 @@ class _RealSyncContext:
         with self._lock:
             req_id = self._next_req
             self._next_req += 1
-        fut = self._executor().submit(self._fetch_range, peer_id, start,
-                                      count)
+        fut = self._submit(self._fetch_range, peer_id, start, count)
         self.inflight[req_id] = (owner, peer_id, fut, "range")
         return req_id
 
@@ -149,7 +178,7 @@ class _RealSyncContext:
         with self._lock:
             req_id = self._next_req
             self._next_req += 1
-        fut = self._executor().submit(self._fetch_root, peer_id, root)
+        fut = self._submit(self._fetch_root, peer_id, root)
         self.inflight[req_id] = (owner, peer_id, fut, "root")
         return req_id
 
@@ -196,6 +225,12 @@ class SyncManager:
         # caller that waited on a concurrent sync still reports its
         # progress.
         self._drive_lock = threading.RLock()
+
+    def stop(self) -> None:
+        """Refuse new downloads and cancel queued ones; in-flight request
+        threads drain into failed results instead of raising into a
+        closed transport."""
+        self.ctx.close()
 
     # -- entry points (round-3 signatures) -----------------------------------
 

@@ -24,6 +24,7 @@ import secrets
 import socket
 import threading
 
+from ..utils.threads import ThreadGroup
 from . import multistream as ms
 from . import secp256k1
 from .gossipsub_pb import unframe
@@ -122,8 +123,8 @@ class Peer:
     # -- inbound streams -------------------------------------------------------
 
     def _on_inbound_stream(self, stream: Stream) -> None:
-        threading.Thread(target=self._serve_stream, args=(stream,),
-                         daemon=True).start()
+        self.transport._threads.spawn(self._serve_stream, stream,
+                                      name="peer.serve_stream")
 
     def _serve_stream(self, stream: Stream) -> None:
         try:
@@ -209,11 +210,14 @@ class Transport:
         self.rpc_protocols: list[str] = []
         self.peers: dict[str, Peer] = {}
         self._stop = False
+        self._threads = ThreadGroup("transport")
 
     def start(self) -> None:
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        self._threads.spawn(self._accept_loop, name="transport.accept")
 
     def stop(self) -> None:
+        # close the sockets first (unblocks accept/read threads), then
+        # join them so no transport thread outlives the transport
         self._stop = True
         try:
             self.listener.close()
@@ -221,6 +225,7 @@ class Transport:
             pass
         for p in list(self.peers.values()):
             p.close()
+        self._threads.join_all(timeout=2)
 
     def _accept_loop(self) -> None:
         while not self._stop:
@@ -228,8 +233,8 @@ class Transport:
                 sock, addr = self.listener.accept()
             except OSError:
                 return
-            threading.Thread(target=self._upgrade_in,
-                             args=(sock, addr), daemon=True).start()
+            self._threads.spawn(self._upgrade_in, sock, addr,
+                                name="transport.upgrade_in")
 
     # -- the upgrade path ------------------------------------------------------
 
@@ -262,8 +267,8 @@ class Transport:
 
     def _register(self, peer: Peer) -> None:
         self.peers[peer.node_id] = peer
-        threading.Thread(target=self._read_loop, args=(peer,),
-                         daemon=True).start()
+        self._threads.spawn(self._read_loop, peer,
+                            name="transport.read_loop")
         self.on_peer(peer)
 
     def _read_loop(self, peer: Peer) -> None:

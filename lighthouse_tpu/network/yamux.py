@@ -134,24 +134,40 @@ class Stream:
         return buf
 
     def close(self) -> None:
-        """Half-close our sending direction (FIN)."""
+        """Half-close our sending direction (FIN). Best-effort at
+        teardown: the peer (and its socket) may already be gone."""
         if not self.send_closed:
             self.send_closed = True
-            self.session._send(encode_frame(TYPE_DATA, FLAG_FIN, self.id))
+            try:
+                self.session._send(encode_frame(TYPE_DATA, FLAG_FIN,
+                                                self.id))
+            except YamuxError:
+                pass
         self.session._maybe_gc(self)
 
     def rst(self) -> None:
-        self.reset = True
-        self.session._send(encode_frame(TYPE_DATA, FLAG_RST, self.id))
+        # mark + WAKE waiters under the condvar (a blocked read would
+        # otherwise sleep out its full timeout), then best-effort RST on
+        # the wire — during shutdown the socket may already be closed
+        # (round-5 leak: OSError escaping a serve_stream thread)
+        with self.cv:
+            self.reset = True
+            self.cv.notify_all()
+        try:
+            self.session._send(encode_frame(TYPE_DATA, FLAG_RST, self.id))
+        except YamuxError:
+            pass
         self.session._maybe_gc(self)
 
     def _replenish(self, n: int) -> None:
-        self.recv_window -= n
-        if self.recv_window <= DEFAULT_WINDOW // 2:
+        with self.cv:
+            self.recv_window -= n
+            if self.recv_window > DEFAULT_WINDOW // 2:
+                return
             delta = DEFAULT_WINDOW - self.recv_window
             self.recv_window = DEFAULT_WINDOW
-            self.session._send(encode_frame(TYPE_WINDOW_UPDATE, 0,
-                                            self.id, length=delta))
+        self.session._send(encode_frame(TYPE_WINDOW_UPDATE, 0,
+                                        self.id, length=delta))
 
     # -- session side ---------------------------------------------------------
 
@@ -193,8 +209,16 @@ class Session:
 
     def _send(self, frame: bytes) -> None:
         with self._lock:
-            if not self.closed:
+            if self.closed:
+                return
+            try:
                 self._send_fn(frame)
+            except OSError as e:
+                # wire gone mid-write (teardown race): the session is
+                # dead; surface a protocol error instead of letting the
+                # raw OSError escape on a service thread
+                self.closed = True
+                raise YamuxError("session write failed") from e
 
     def _maybe_gc(self, st: Stream) -> None:
         """Drop fully-dead streams so long-lived connections (one stream
@@ -218,25 +242,38 @@ class Session:
 
     def goaway(self, code: int = 0) -> None:
         self._send(encode_frame(TYPE_GOAWAY, 0, 0, length=code))
-        self.closed = True
+        with self._lock:
+            self.closed = True
 
     # -- inbound pump ---------------------------------------------------------
 
     def on_bytes(self, data: bytes) -> None:
-        """Feed raw wire bytes; dispatches complete frames."""
-        self._buf += data
-        while True:
-            if len(self._buf) < 12:
-                return
-            ftype, flags, sid, length = decode_header(bytes(self._buf[:12]))
+        """Feed raw wire bytes; dispatches complete frames.
+
+        Framing happens under the session lock (the reassembly buffer is
+        shared state); dispatch runs OUTSIDE it — handlers send ACKs and
+        window updates through `_send`, which takes the same lock."""
+        frames = []
+        with self._lock:
+            self._buf += data
+            while True:
+                if len(self._buf) < 12:
+                    break
+                ftype, flags, sid, length = decode_header(
+                    bytes(self._buf[:12]))
+                if ftype == TYPE_DATA:
+                    if len(self._buf) < 12 + length:
+                        break
+                    payload = bytes(self._buf[12:12 + length])
+                    del self._buf[:12 + length]
+                    frames.append((ftype, flags, sid, length, payload))
+                else:
+                    del self._buf[:12]
+                    frames.append((ftype, flags, sid, length, b""))
+        for ftype, flags, sid, length, payload in frames:
             if ftype == TYPE_DATA:
-                if len(self._buf) < 12 + length:
-                    return
-                payload = bytes(self._buf[12:12 + length])
-                del self._buf[:12 + length]
                 self._dispatch_data(sid, flags, payload)
             else:
-                del self._buf[:12]
                 self._dispatch_ctrl(ftype, flags, sid, length)
 
     def _dispatch_data(self, sid: int, flags: int, payload: bytes) -> None:
@@ -279,8 +316,11 @@ class Session:
             if self.on_ping:
                 self.on_ping(length, flags)
         elif ftype == TYPE_GOAWAY:
-            self.goaway_code = length
-            self.closed = True
+            # dispatch runs outside the session lock (see on_bytes), so
+            # the closed flag must be flipped under it like everywhere else
+            with self._lock:
+                self.goaway_code = length
+                self.closed = True
 
 
 class StreamIO:

@@ -15,8 +15,12 @@ from __future__ import annotations
 import functools
 
 import jax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:                              # jax >= 0.4.35 exports it at top level
+    from jax import shard_map
+except ImportError:               # older jax: experimental location
+    from jax.experimental.shard_map import shard_map
 
 from ..ops.bls12_381 import (
     final_exponentiation,
@@ -35,6 +39,48 @@ def _local_miller_product(px, py, qx, qy):
     return fp12_product(fs)[None]              # [1, 2, 3, 2, 32]
 
 
+def _local_masked_product(lpx, lpy, lqx, lqy, lmask):
+    import jax.numpy as jnp_
+    fs = miller_loop_batch(lpx, lpy, lqx, lqy)
+    one = fp12_one_like((fs.shape[0],))
+    fs = jnp_.where(lmask[:, None, None, None, None], fs, one)
+    return fp12_product(fs)[None]
+
+
+# Memoized jitted programs per (mesh, axis): a fresh jit(shard_map(...))
+# per call would rebuild the wrapper — and the shard_map closure under it
+# — every time, so every call re-traced (graftlint: recompile-hazard).
+
+@functools.lru_cache(maxsize=None)
+def _miller_product_fn(mesh: Mesh, axis: str):
+    return jax.jit(shard_map(
+        _local_miller_product, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(axis)))
+
+
+@functools.lru_cache(maxsize=None)
+def _masked_product_fn(mesh: Mesh, axis: str):
+    return jax.jit(shard_map(
+        _local_masked_product, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(axis)))
+
+
+@functools.lru_cache(maxsize=None)
+def _scalar_mul_fns(mesh: Mesh, axis: str):
+    import lighthouse_tpu.ops.bls12_381 as k
+    g1 = jax.jit(shard_map(
+        k.g1_scalar_mul, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis))))
+    g2 = jax.jit(shard_map(
+        k.g2_scalar_mul, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis))))
+    return g1, g2
+
+
 def sharded_pairing_check(mesh: Mesh, px, py, qx, qy,
                           axis: str = "batch"):
     """prod_i e(P_i, Q_i) == 1 with the pair batch row-sharded over the
@@ -46,13 +92,8 @@ def sharded_pairing_check(mesh: Mesh, px, py, qx, qy,
     stage 2 (tiny product + the shared final exponentiation + identity
     check) runs as separate cached programs on the gathered result.  One
     fused program here was the round-2 ~12-minute compile."""
-    fn = shard_map(
-        _local_miller_product,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=P(axis),
-    )
-    partials = jax.jit(fn)(px, py, qx, qy)     # [n_dev, 2, 3, 2, 32]
+    partials = _miller_product_fn(mesh, axis)(px, py, qx,
+                                              qy)  # [n_dev, 2, 3, 2, 32]
     out = final_exponentiation(fp12_product(partials))
     return fp12_eq(out[None], fp12_one_like((1,)))[0]
 
@@ -128,14 +169,7 @@ def sharded_verify_signature_sets(mesh: Mesh, sets, lanes: int,
     one1 = np.broadcast_to(k.FP_ONE, (lanes, bi.NLIMBS))
     bits_pk = k.scalars_to_bits(prep["pk_rands"], 64)
     bits_sig = k.scalars_to_bits(prep["sig_rands"], 64)
-    g1_sharded = jax.jit(shard_map(
-        k.g1_scalar_mul, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis))))
-    g2_sharded = jax.jit(shard_map(
-        k.g2_scalar_mul, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis))))
+    g1_sharded, g2_sharded = _scalar_mul_fns(mesh, axis)
     spx, spy, spz = g1_sharded(jnp.asarray(prep["pk_x"]),
                                jnp.asarray(prep["pk_y"]),
                                jnp.asarray(one1), jnp.asarray(bits_pk))
@@ -169,17 +203,7 @@ def sharded_verify_signature_sets(mesh: Mesh, sets, lanes: int,
     full_mask[:lanes] = mask
     full_mask[lanes] = True               # the one real aggregate lane
 
-    def _local_masked_product(lpx, lpy, lqx, lqy, lmask):
-        fs = miller_loop_batch(lpx, lpy, lqx, lqy)
-        one = fp12_one_like((fs.shape[0],))
-        import jax.numpy as jnp_
-        fs = jnp_.where(lmask[:, None, None, None, None], fs, one)
-        return fp12_product(fs)[None]
-
-    masked_fn = jax.jit(shard_map(
-        _local_masked_product, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
-        out_specs=P(axis)))
-    partials = masked_fn(px, py, qx, qy, jnp.asarray(full_mask))
+    partials = _masked_product_fn(mesh, axis)(px, py, qx, qy,
+                                              jnp.asarray(full_mask))
     out = final_exponentiation(fp12_product(partials))
     return bool(np.asarray(fp12_eq(out[None], fp12_one_like((1,)))[0]))

@@ -13,7 +13,11 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:                              # jax >= 0.4.35 exports it at top level
+    from jax import shard_map
+except ImportError:               # older jax: experimental location
+    from jax.experimental.shard_map import shard_map
 
 from ..ops.sha256 import hash_pairs, merkleize_dense
 
@@ -29,6 +33,22 @@ def _subtree_then_top(local_leaves: jax.Array, subtree_depth: int,
     return top[0:1]
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_merkleize_fn(mesh: Mesh, subtree_depth: int, top_depth: int,
+                          axis: str):
+    """Memoized jitted program per (mesh, depths): a fresh
+    jit(shard_map(...)) per call would re-trace every call
+    (graftlint: recompile-hazard)."""
+    fn = shard_map(
+        functools.partial(_subtree_then_top, subtree_depth=subtree_depth,
+                          top_depth=top_depth, axis=axis),
+        mesh=mesh,
+        in_specs=(P(axis, None),),
+        out_specs=P(axis, None),
+    )
+    return jax.jit(fn)
+
+
 def sharded_merkleize(mesh: Mesh, leaves: jax.Array,
                       axis: str = "batch") -> jax.Array:
     """Merkleize u32[N, 8] leaves sharded over the mesh (N and N/n_devices
@@ -41,15 +61,9 @@ def sharded_merkleize(mesh: Mesh, leaves: jax.Array,
     subtree_depth = (local - 1).bit_length()
     top_depth = (n_dev - 1).bit_length()
 
-    fn = shard_map(
-        functools.partial(_subtree_then_top, subtree_depth=subtree_depth,
-                          top_depth=top_depth, axis=axis),
-        mesh=mesh,
-        in_specs=(P(axis, None),),
-        out_specs=P(axis, None),
-    )
     # each shard returns the (identical) root; take shard 0's copy
-    out = jax.jit(fn)(leaves.reshape(n, 8))
+    out = _sharded_merkleize_fn(mesh, subtree_depth, top_depth,
+                                axis)(leaves.reshape(n, 8))
     return out[0]
 
 

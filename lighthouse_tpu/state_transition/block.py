@@ -19,7 +19,7 @@ from ..specs.constants import (
     BLS_WITHDRAWAL_PREFIX, COMPOUNDING_WITHDRAWAL_PREFIX,
     DEPOSIT_CONTRACT_TREE_DEPTH, ETH1_ADDRESS_WITHDRAWAL_PREFIX,
     FAR_FUTURE_EPOCH, FULL_EXIT_REQUEST_AMOUNT, GENESIS_SLOT,
-    PARTICIPATION_FLAG_WEIGHTS, PROPOSER_WEIGHT,
+    PARTICIPATION_FLAG_WEIGHTS, PROPOSER_WEIGHT, SYNC_REWARD_WEIGHT,
     TIMELY_HEAD_FLAG_INDEX, TIMELY_SOURCE_FLAG_INDEX,
     TIMELY_TARGET_FLAG_INDEX, UNSET_DEPOSIT_REQUESTS_START_INDEX,
     WEIGHT_DENOMINATOR,
@@ -656,7 +656,7 @@ def process_sync_aggregate(state: BeaconState, sync_aggregate, block_slot: int,
     total_increments = total_active // p.effective_balance_increment
     base_per_inc = get_base_reward_per_increment(state, total_active)
     total_base_rewards = base_per_inc * total_increments
-    max_participant_rewards = (total_base_rewards * 2  # SYNC_REWARD_WEIGHT
+    max_participant_rewards = (total_base_rewards * SYNC_REWARD_WEIGHT
                                // WEIGHT_DENOMINATOR // p.slots_per_epoch)
     participant_reward = max_participant_rewards // p.sync_committee_size
     proposer_reward = (participant_reward * PROPOSER_WEIGHT

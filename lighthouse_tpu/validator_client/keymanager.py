@@ -43,6 +43,10 @@ class KeymanagerServer:
 
     def stop(self) -> None:
         self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
 
     # -- handlers ------------------------------------------------------------
 

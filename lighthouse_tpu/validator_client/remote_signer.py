@@ -81,10 +81,14 @@ class MockWeb3Signer:
                 self.wfile.write(out)
 
         self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        threading.Thread(target=self._server.serve_forever,
-                         daemon=True).start()
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
         return f"http://127.0.0.1:{self._server.server_port}"
 
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()
+        if getattr(self, "_thread", None) is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

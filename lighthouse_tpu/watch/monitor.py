@@ -381,3 +381,5 @@ class WatchServer:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)

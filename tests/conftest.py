@@ -8,6 +8,10 @@ Benchmarks (bench.py) run on the real TPU chip instead.
 """
 import os
 import sys
+import threading
+import traceback
+
+import pytest
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 # NOTE: the axon TPU plugin claims the (single) chip at *interpreter startup*
@@ -23,3 +27,80 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# -- uncaught-thread-exception recorder --------------------------------------
+# Round-5 review found unhandled thread exceptions in GREEN runs: daemon
+# threads raced service shutdown and blew up into closed sockets, and pytest
+# only printed them as noise. Record every uncaught thread exception and fail
+# the session — a green run must mean no thread died screaming.
+
+_THREAD_EXCEPTIONS: list = []
+
+
+def _install_recorder():
+    """Chain-wrap whatever excepthook is current (pytest's own
+    threadexception plugin installs one in its pytest_configure, so this
+    must run both at import time and again at sessionstart)."""
+    inner = threading.excepthook
+    if getattr(inner, "_lhtpu_recorder", False):
+        return
+
+    def _recording_excepthook(args):
+        _THREAD_EXCEPTIONS.append(args)
+        inner(args)
+
+    _recording_excepthook._lhtpu_recorder = True
+    threading.excepthook = _recording_excepthook
+
+
+_install_recorder()
+
+
+def pytest_sessionstart(session):
+    _install_recorder()
+
+
+@pytest.fixture
+def thread_exceptions():
+    """Tests that deliberately crash a thread can consume the record."""
+    return _THREAD_EXCEPTIONS
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _THREAD_EXCEPTIONS and session.exitstatus == 0:
+        session.exitstatus = 1
+
+
+def pytest_terminal_summary(terminalreporter):
+    if not _THREAD_EXCEPTIONS:
+        return
+    terminalreporter.section("uncaught thread exceptions (session FAILED)")
+    for args in _THREAD_EXCEPTIONS:
+        name = args.thread.name if args.thread is not None else "<unknown>"
+        terminalreporter.write_line(f"thread {name!r}:")
+        for line in traceback.format_exception(
+                args.exc_type, args.exc_value, args.exc_traceback):
+            terminalreporter.write_line("  " + line.rstrip())
+
+
+# -- --sanitize: strict-numerics mode for the kernel tests -------------------
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--sanitize", action="store_true", default=False,
+        help="run kernel tests with jax_debug_nans and "
+             "jax_numpy_rank_promotion='raise' (slower, catches silent "
+             "NaNs and accidental broadcasts)")
+
+
+def pytest_configure(config):
+    if config.getoption("--sanitize"):
+        # set before any test module imports jax so the config sticks;
+        # also update in-process in case a plugin imported jax already
+        os.environ["JAX_DEBUG_NANS"] = "True"
+        os.environ["JAX_NUMPY_RANK_PROMOTION"] = "raise"
+        if "jax" in sys.modules:
+            import jax
+            jax.config.update("jax_debug_nans", True)
+            jax.config.update("jax_numpy_rank_promotion", "raise")

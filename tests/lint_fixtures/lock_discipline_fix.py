@@ -1,0 +1,54 @@
+"""Seeded fixture for the lock-discipline rule.
+
+True positives are tagged ``seeded``. Negatives cover the exemptions:
+``__init__`` construction writes, guarded-everywhere attributes, nested
+callbacks, and classes that own no lock at all.
+"""
+import threading
+
+
+class BadCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0         # __init__ precedes sharing: exempt
+        self.state = "idle"
+
+    def bump(self):
+        self.count += 1  # seeded
+
+    def set_state(self, s):
+        with self._lock:
+            self.state = s
+
+    def reset(self):
+        self.state = "idle"  # seeded
+
+
+class GoodService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def drain(self):
+        with self._lock:
+            out = self.items
+            self.items = []
+        return out
+
+    def make_callback(self):
+        def cb():
+            # nested defs have their own threading story: out of scope
+            self.items = []
+        return cb
+
+
+class NoLockNoProblem:
+    def __init__(self):
+        self.hits = 0
+
+    def bump(self):
+        self.hits += 1         # no lock in the class: the rule is silent

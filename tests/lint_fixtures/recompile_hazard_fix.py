@@ -1,0 +1,51 @@
+"""Seeded fixture for the recompile-hazard rule.
+
+True positives are tagged ``seeded``; the negatives at the bottom are
+the sanctioned idioms (module-level wrap, memoized factory, hashable
+static args). AST-scanned only, never imported.
+"""
+import functools
+
+import jax
+
+
+@jax.jit
+def bad_list_arg(xs: list):  # seeded
+    return xs
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def bad_static_dict(x, cfg={}):  # seeded
+    return x
+
+
+def bad_rewrap(x):
+    f = jax.jit(lambda v: v * 2)  # seeded
+    return f(x)
+
+
+def bad_shardmap_rewrap(mesh, x):
+    g = jax.jit(shard_map(lambda v: v, mesh))  # seeded
+    return g(x)
+
+
+class Kernels:
+    @jax.jit
+    def bad_method(self, x):  # seeded
+        return x
+
+
+# -- true negatives ----------------------------------------------------------
+
+@jax.jit
+def good_tuple_static(x, dims: tuple = ()):
+    return x
+
+
+_GOOD_WRAPPED = jax.jit(lambda v: v + 1)   # module-level wrap: traced once
+
+
+@functools.lru_cache(maxsize=None)
+def make_kernel(n: int):
+    # memoized factory: the wrapper (and its trace cache) is reused
+    return jax.jit(lambda v: v * n)

@@ -1,0 +1,46 @@
+"""Seeded fixture for the spec-constant-drift rule.
+
+True positives are tagged ``seeded``. Negatives cover the tuned-out
+idioms: own named constants, context-free small values, hex bitmasks,
+slice bounds, and ``to_bytes`` length arguments. Values reference the
+real ``specs/constants.py`` table.
+"""
+
+MAX_LOCAL_DEPTH = 32           # own named constant: the cure, not drift
+
+
+def far_future_default():
+    return 2**64 - 1  # seeded
+
+
+def builder_domain():
+    domain = 16777216  # seeded
+    return domain
+
+
+def topic_for(subnet_id):
+    sync_subnet = subnet_id % 4  # seeded
+    return sync_subnet
+
+
+def verify_deposit(proof, leaf):
+    tree_depth = 32  # seeded
+    return len(proof) == tree_depth
+
+
+# -- true negatives ----------------------------------------------------------
+
+def unrelated_four():
+    return 2 + 2               # small value, zero name context: silent
+
+
+def lane_mask(x):
+    return x & 0xFFFFFFFFFFFFFFFF   # hex all-ones is a bitmask, not drift
+
+
+def first_bytes(buf):
+    return buf[:32]            # slice bounds are byte plumbing
+
+
+def pack(value):
+    return value.to_bytes(32, "little")   # length arg, not a spec value

@@ -1,0 +1,36 @@
+"""Seeded fixture for the ssz-schema rule.
+
+True positives are tagged ``seeded``. The ``__future__`` import is
+itself the worst one: it stringifies every annotation, so the
+``@container`` decorator would build a ZERO-field schema. AST-scanned
+only, never imported.
+"""
+from __future__ import annotations  # seeded
+
+from lighthouse_tpu.ssz import Bytes32, List, container, uint64
+
+
+@container
+class BadHeader:
+    slot: uint64
+    parent_root: Bytes32
+    proposer: int  # seeded
+    body_root: "Bytes32"  # seeded
+    cache = {}  # seeded
+
+
+# -- true negatives ----------------------------------------------------------
+
+@container
+class GoodHeader:
+    slot: uint64
+    parent_root: Bytes32
+    roots: List(Bytes32, 64)
+    mix: DomainAlias           # locally-defined alias: conservatively silent
+    ssz_type = None            # allowed class attr, not a field
+    _cache = None              # underscore attrs are internal, not fields
+
+
+class NotAContainer:
+    plain: int                 # no @container: the rule ignores it
+    data = {}

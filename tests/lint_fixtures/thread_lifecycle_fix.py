@@ -1,0 +1,74 @@
+"""Seeded fixture for the thread-lifecycle rule.
+
+True positives are tagged ``seeded``. Negatives cover every
+accounted-for shape: stored + joined, appended to a joined list, handed
+to a tracker, returned to the caller, cancelled Timer, ThreadGroup.
+"""
+import threading
+
+from lighthouse_tpu.utils.threads import ThreadGroup
+
+
+class BadService:
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()  # seeded
+
+    def start_worker(self):
+        self._worker = threading.Thread(target=self._run)  # seeded
+
+    def schedule(self):
+        # distinct attr name: GoodService cancels `self._timer`, and the
+        # module-wide scan must not launder this one through that
+        self._ping_timer = threading.Timer(5.0, self._run)  # seeded
+        self._ping_timer.start()
+
+    def _run(self):
+        pass
+
+
+# -- true negatives ----------------------------------------------------------
+
+class GoodService:
+    def __init__(self):
+        self._threads = ThreadGroup("good")
+        self._thread = None
+        self._timer = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._threads.spawn(self._run, name="good.aux")
+
+    def schedule(self):
+        self._timer = threading.Timer(5.0, self._run)
+        self._timer.start()
+
+    def stop(self):
+        self._thread.join(timeout=2)
+        self._timer.cancel()
+        self._threads.join_all()
+
+    def _run(self):
+        pass
+
+
+class PoolService:
+    def __init__(self):
+        self._pool = []
+
+    def start(self):
+        for i in range(4):
+            t = threading.Thread(target=print, args=(i,))
+            self._pool.append(t)
+            t.start()
+
+    def stop(self):
+        for t in self._pool:
+            t.join(timeout=1)
+
+
+def spawn_tracked(group):
+    t = threading.Thread(target=print)
+    group.track(t)
+    t.start()
+    return t

@@ -1,0 +1,64 @@
+"""Seeded fixture for the trace-safety rule.
+
+Every true-positive line carries a ``seeded`` marker; everything else
+— including the tricky true-negatives below — must stay silent. This
+file is never imported, only AST-scanned.
+"""
+import time
+
+import jax
+import numpy as np
+
+_DEBUG_SINK = []
+
+
+@jax.jit
+def bad_kernel(x):
+    print("tracing", x)  # seeded
+    t0 = time.time()  # seeded
+    y = x * 2
+    host = float(y)  # seeded
+    arr = np.asarray(y)  # seeded
+    _DEBUG_SINK.append(host)  # seeded
+    return y + arr * t0
+
+
+@jax.jit
+def bad_sync(x):
+    return x.block_until_ready()  # seeded
+
+
+@jax.jit
+def bad_item(x):
+    n = x.sum().item()  # seeded
+    return n
+
+
+def _helper(v):
+    v.tolist()  # seeded
+    return v
+
+
+@jax.jit
+def calls_helper(x):
+    # reachability: _helper has no decorator but is called from a root
+    return _helper(x)
+
+
+# -- true negatives ----------------------------------------------------------
+
+def not_jitted(x):
+    # host-side code may sync and print freely
+    print("host logging is fine")
+    return float(x)
+
+
+@jax.jit
+def good_kernel(x):
+    rows = x.shape[0]          # .shape is a static python int under tracing
+    scale = float(rows)        # float() of a static value: no sync
+    k = len(x.shape)           # len() proves concreteness
+    local = []
+    local.append(k)            # mutating a LOCAL is not a side effect
+    jax.debug.print("rows={r}", r=rows)   # the sanctioned debug path
+    return x * scale

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""graftlint CLI — the single static-analysis entry point.
+
+    python tools/lint/run.py                       # text report, rc!=0 on findings
+    python tools/lint/run.py --format json         # machine-readable
+    python tools/lint/run.py --rules trace-safety,lock-discipline path/
+    python tools/lint/run.py --no-baseline         # raw findings
+
+Exit codes: 0 clean (baselined findings allowed), 1 non-baselined
+violations, 2 usage/baseline-format errors. Pure AST — no jax import, so
+it runs in seconds on any CPU.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+from lighthouse_tpu.analysis import (  # noqa: E402
+    Project, all_rules, load_baseline, run_project,
+)
+from lighthouse_tpu.analysis.engine import (  # noqa: E402
+    render_json, render_text,
+)
+
+DEFAULT_BASELINE = REPO / "lighthouse_tpu" / "analysis" / "baseline.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", type=Path,
+                    default=None, help="files/dirs to scan "
+                    "(default: lighthouse_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule names (default: all)")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the allowlist, report everything")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.rules:
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in wanted if r not in rules]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(have: {', '.join(sorted(rules))})", file=sys.stderr)
+            return 2
+        rules = {k: v for k, v in rules.items() if k in wanted}
+
+    try:
+        baseline = [] if args.no_baseline else load_baseline(args.baseline)
+    except (ValueError, OSError) as e:
+        print(f"baseline error: {e}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or [REPO / "lighthouse_tpu"]
+    project = Project.load(REPO, paths)
+    report = run_project(project, rules, baseline)
+    out = render_json(report) if args.format == "json" else \
+        render_text(report)
+    print(out)
+    return 1 if report["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
