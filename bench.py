@@ -94,11 +94,14 @@ def bench_tree_hash():
         b_root = bc.hash_tree_root(vrl)
         return v_root, b_root
 
-    run()  # warm up compiles + build the device-resident leaves
+    from lighthouse_tpu import obs
+    with obs.span("bench_stage", stage="tree_hash_warmup"):
+        run()  # warm up compiles + build the device-resident leaves
     times = []
     for _ in range(5):
         t0 = time.perf_counter()
-        run()
+        with obs.span("bench_stage", stage="tree_hash_rep"):
+            run()
         times.append((time.perf_counter() - t0) * 1000)
     return min(times)
 
@@ -131,14 +134,21 @@ def bench_bls():
         sk = 1000 + i
         sets.append(SignatureSet(signer.sign(sk, msg),
                                  [signer.sk_to_pk(sk)], msg))
+    from lighthouse_tpu import obs
     tpu = bls.set_backend("tpu")
-    assert tpu.verify_signature_sets(sets), "bench batch must verify"
+    with obs.span("bench_stage", stage="bls_warmup"):
+        assert tpu.verify_signature_sets(sets), "bench batch must verify"
     times = []
     for _ in range(2):
         t0 = time.perf_counter()
-        assert tpu.verify_signature_sets(sets)
+        with obs.span("bench_stage", stage="bls_verify"):
+            assert tpu.verify_signature_sets(sets)
         times.append(time.perf_counter() - t0)
     secs = min(times)
+    # bls_device_pairing_seconds is catalog-declared but only observable
+    # end-to-end here (EXTERNALLY_FED): record the per-batch device time
+    import lighthouse_tpu.api.metrics_defs as _md
+    _md.observe("bls_device_pairing_seconds", secs)
     return n / secs, n
 
 
@@ -167,16 +177,20 @@ def bench_mont_mul_modes():
     def chain(v):
         return lax.fori_loop(0, K, lambda i, acc: bi.mont_mul(acc, v), v)
 
+    from lighthouse_tpu import obs
     out = {}
     try:
         for mode in (0, 1, 2):
             bi.set_mxu_mode(mode)
             f = jax.jit(chain)
-            f(x).block_until_ready()             # compile + warm
+            with obs.span("bench_stage", stage=f"mont_mul_mode{mode}_warm"):
+                f(x).block_until_ready()         # compile + warm
             best = float("inf")
             for _ in range(3):
                 t0 = time.perf_counter()
-                f(x).block_until_ready()
+                with obs.span("bench_stage",
+                              stage=f"mont_mul_mode{mode}"):
+                    f(x).block_until_ready()
                 best = min(best, time.perf_counter() - t0)
             out[mode] = B * K / best
     finally:
@@ -198,6 +212,28 @@ def _measured_host_baseline():
     if per_sec < BLST_BASELINE_SIGS_PER_SEC:
         return BLST_BASELINE_SIGS_PER_SEC, "estimate-floor"
     return per_sec, "measured-cpp-4core"
+
+
+def _write_trace_artifacts(mode: str, out_dir: str) -> str | None:
+    """bench --trace: dump the child's graftscope spans as Chrome-trace
+    JSON plus a per-stage summary next to the BENCH_*.json records, so a
+    perf PR attaches stage-level evidence, not just end-to-end numbers.
+    Returns the trace path (or None when no spans were recorded)."""
+    from lighthouse_tpu import obs
+    spans = obs.snapshot()
+    if not spans:
+        return None
+    trace_path = os.path.join(out_dir, f"BENCH_TRACE_{mode}.json")
+    with open(trace_path, "w") as f:
+        json.dump(obs.chrome_trace(spans), f)
+    summary = {
+        "stages": obs.summarize_spans(spans),
+        "jax": obs.jax_counters(),
+    }
+    with open(os.path.join(out_dir,
+                           f"BENCH_TRACE_{mode}_summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    return trace_path
 
 
 def child_main():
@@ -237,6 +273,10 @@ def child_main():
             "vs_baseline": round(TARGET_MS / ms, 3),
             "platform": platform,
         }
+    if os.environ.get("LHTPU_BENCH_TRACE"):
+        trace_path = _write_trace_artifacts(mode, _REPO)
+        if trace_path is not None:
+            rec["trace_file"] = os.path.basename(trace_path)
     print(json.dumps(rec), flush=True)
 
 
@@ -329,6 +369,10 @@ def _mxu_record(force_cpu: bool):
 
 
 def main():
+    if "--trace" in sys.argv:
+        # children inherit via _child_env(dict(os.environ)) and write
+        # BENCH_TRACE_<mode>.json + _summary.json next to BENCH_*.json
+        os.environ["LHTPU_BENCH_TRACE"] = "1"
     if os.environ.get("LHTPU_BENCH_CHILD"):
         return child_main()
     errors = []

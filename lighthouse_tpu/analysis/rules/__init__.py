@@ -1,5 +1,6 @@
 """Rule modules register themselves with the engine on import."""
 from . import (  # noqa: F401
+    device_transfer,
     lock_discipline,
     recompilation,
     spec_constants,

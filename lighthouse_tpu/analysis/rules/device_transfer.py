@@ -1,0 +1,232 @@
+"""device-transfer: unaccounted host round-trips at shard boundaries.
+
+Scope: modules that actually import the sharding machinery
+(``jax.sharding`` / ``shard_map``) — in this tree, ``parallel/``.  At a
+shard boundary a host<->device transfer is either part of the designed
+dataflow (placement via ``in_specs``/``NamedSharding``, readback of the
+final verdict) or a silent performance bug (a mid-pipeline sync
+serializes the mesh).  Either way it must be *visible*: the sanctioned
+crossings are ``obs.jax_accounting.host_readback`` (device->host,
+byte-accounted into ``jax_transfer_device_to_host_bytes_total``) and
+``parallel.mesh.shard_batch`` (host->device, accounted likewise).
+
+Flagged:
+
+1. ``jax.device_put(x)`` with no explicit placement — pins the array to
+   the default device, which at a shard boundary is a resharding hazard;
+   pass a ``NamedSharding`` (second argument / ``device=``) or let the
+   sharded program's ``in_specs`` place it.
+2. ``np.asarray`` / ``np.array`` / ``np.frombuffer`` / ``jax.device_get``
+   on a *device-tainted* value — a host round-trip that bypasses the
+   transfer accounting.  Route it through ``host_readback()``.
+
+Device taint seeds per function: results of ``jnp.*`` / ``jax.lax.*``
+calls, calls into ``ops/`` kernels (resolved through imports and module
+aliases), ``jax.device_put`` results, and factory double-calls
+``fn(...)(...)`` (the memoized jit(shard_map) idiom); taint propagates
+through assignments.  Host-side numpy work (mesh construction, padding
+tables) stays silent.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Module, Project, Rule, dotted_name, enclosing_symbol, \
+    rule
+
+_HOST_PULLS = {"np.asarray", "np.array", "np.frombuffer",
+               "numpy.asarray", "numpy.array", "numpy.frombuffer",
+               "onp.asarray", "onp.array", "jax.device_get"}
+_SHARDING_MODULES = ("jax.sharding", "jax.experimental.shard_map")
+
+
+def _module_is_scoped(mod: Module) -> bool:
+    """True when the module imports the sharding machinery (or lives
+    under parallel/) — the rule's blast radius stays at shard code."""
+    if "/parallel/" in mod.relpath.replace("\\", "/"):
+        return True
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module is not None:
+            if node.module in _SHARDING_MODULES or \
+                    node.module == "jax" and any(
+                        a.name == "shard_map" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name in _SHARDING_MODULES for a in node.names):
+                return True
+    return False
+
+
+def _ops_bindings(mod: Module) -> tuple[set[str], set[str]]:
+    """(aliases, names): module aliases bound to ops kernels
+    (``import lighthouse_tpu.ops.x as k``) and names from-imported out
+    of ops modules (``from ..ops.x import fp12_eq``)."""
+    aliases: set[str] = set()
+    names: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if ".ops." in a.name or a.name.endswith(".ops"):
+                    aliases.add(a.asname or a.name.split(".")[-1])
+        elif isinstance(node, ast.ImportFrom) and node.module is not None:
+            m = node.module.lstrip(".")
+            if m.startswith("ops.") or ".ops." in m or m == "ops":
+                for a in node.names:
+                    names.add(a.asname or a.name)
+    return aliases, names
+
+
+def _bare_device_put(node: ast.Call) -> bool:
+    """jax.device_put with no explicit placement."""
+    if dotted_name(node.func) != "jax.device_put":
+        return False
+    if len(node.args) >= 2:
+        return False
+    return not any(kw.arg in ("device", "sharding") for kw in node.keywords)
+
+
+def _iter_scope(body: list[ast.stmt]):
+    """Walk a statement list WITHOUT descending into nested function or
+    class definitions (each gets its own _Scope)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+class _Scope:
+    """Device-taint analysis of one function (or the module body)."""
+
+    def __init__(self, rule_name: str, mod: Module, symbol: str,
+                 body: list[ast.stmt], ops_aliases: set[str],
+                 ops_names: set[str]):
+        self.rule_name = rule_name
+        self.mod = mod
+        self.symbol = symbol
+        self.ops_aliases = ops_aliases
+        self.ops_names = ops_names
+        self.tainted: set[str] = set()
+        self.violations: list = []
+        # two passes so loops see taint settled by later statements
+        for _ in range(2):
+            for stmt in body:
+                self._collect(stmt)
+        for stmt in body:
+            self._check(stmt)
+
+    # -- taint ---------------------------------------------------------------
+
+    def _seed_call(self, node: ast.Call) -> bool:
+        if isinstance(node.func, ast.Call):
+            return True            # factory double-call: fn(mesh)( ... )
+        fn = dotted_name(node.func)
+        if not fn:
+            return False
+        if fn.startswith(("jnp.", "jax.lax.", "jax.numpy.")):
+            return True
+        if fn == "jax.device_put":
+            return True
+        head = fn.split(".")[0]
+        if head in self.ops_aliases:
+            return True
+        return fn in self.ops_names
+
+    def _tainted_expr(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+            if isinstance(sub, ast.Call) and self._seed_call(sub):
+                return True
+        return False
+
+    def _collect(self, stmt: ast.AST) -> None:
+        for node in _iter_scope([stmt]):
+            targets, value = [], None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) and \
+                    node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.For):
+                targets, value = [node.target], node.iter
+            else:
+                continue
+            if self._tainted_expr(value):
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            self.tainted.add(n.id)
+
+    # -- checks --------------------------------------------------------------
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        self.violations.append(self.mod.violation(
+            self.rule_name, node, message, symbol=self.symbol))
+
+    def _check(self, stmt: ast.AST) -> None:
+        for node in _iter_scope([stmt]):
+            if not isinstance(node, ast.Call):
+                continue
+            if _bare_device_put(node):
+                self._flag(node, "bare jax.device_put pins to the default "
+                                 "device at a shard boundary — pass an "
+                                 "explicit NamedSharding (or let in_specs "
+                                 "place it); accounted placement lives in "
+                                 "parallel.mesh.shard_batch")
+                continue
+            fn = dotted_name(node.func)
+            if fn in _HOST_PULLS and node.args and \
+                    self._tainted_expr(node.args[0]):
+                self._flag(node, f"{fn}() on a device value is an "
+                                 "unaccounted host round-trip at a shard "
+                                 "boundary — route it through "
+                                 "obs.jax_accounting.host_readback() so "
+                                 "transfer bytes are observable")
+
+
+@rule
+class DeviceTransferRule(Rule):
+    name = "device-transfer"
+    description = ("unaccounted host round-trips / bare device_put at "
+                   "shard boundaries (sharding-scoped modules)")
+
+    def check_module(self, module: Module, project: Project) -> list:
+        if not _module_is_scoped(module):
+            return []
+        aliases, names = _ops_bindings(module)
+        out: list = []
+
+        # module-level body (function/class defs get their own scope)
+        top = [s for s in module.tree.body
+               if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef))]
+        out.extend(_Scope(self.name, module, "", top, aliases,
+                          names).violations)
+
+        stack: list[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    stack.append(child)
+                    out.extend(_Scope(
+                        self.name, module,
+                        enclosing_symbol(stack), child.body, aliases,
+                        names).violations)
+                    visit(child)
+                    stack.pop()
+                elif isinstance(child, ast.ClassDef):
+                    stack.append(child)
+                    visit(child)
+                    stack.pop()
+                else:
+                    visit(child)
+
+        visit(module.tree)
+        return out

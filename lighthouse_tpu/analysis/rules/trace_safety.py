@@ -43,6 +43,17 @@ _TAINT_LAUNDER = {"shape", "ndim", "dtype"}
 #: static at trace time (a tracer would already have raised), so data
 #: derived through them is host data, not a sync
 _CONCRETIZERS = {"bin", "hex", "oct", "len", "range"}
+#: graftscope (lighthouse_tpu/obs) span calls are sanctioned non-effects:
+#: host-side orchestrators open spans freely, and the rule neither
+#: follows these call edges into the tracing implementation (whose
+#: perf_counter use is the point) nor flags the calls themselves.  A
+#: span INSIDE a traced function still only runs at trace time — obs
+#: documents that; the sanction is for jit-reachable *host* wrappers.
+_SANCTIONED_TRACE_CALLS = {"span", "annotate", "record_event",
+                           "current_span", "capture", "attach",
+                           "host_readback", "account_transfer"}
+#: modules never entered by the reachability BFS
+_SANCTIONED_MODULE_PARTS = ("/obs/",)
 
 
 def _func_key(mod: Module, qualname: str) -> tuple[str, str]:
@@ -309,6 +320,8 @@ class TraceSafetyRule(Rule):
             if fn is None:
                 continue
             for called in _called_names(fn):
+                if called.split(".")[-1] in _SANCTIONED_TRACE_CALLS:
+                    continue
                 base = called.split(".")[-1] if "." not in called \
                     else None
                 cands: list[tuple[str, str]] = []
@@ -340,6 +353,9 @@ class TraceSafetyRule(Rule):
                             attr in indexes[target.relpath].funcs:
                         cands.append((target.relpath, attr))
                 for cand in cands:
+                    if any(part in cand[0]
+                           for part in _SANCTIONED_MODULE_PARTS):
+                        continue     # obs internals are sanctioned
                     if cand not in reachable:
                         reachable.add(cand)
                         work.append(cand)

@@ -13,6 +13,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from .. import obs
 from ..ssz import deserialize, serialize
 from ..utils.log_buffer import global_log_buffer, to_sse
 from .backend import ApiBackend, ApiError
@@ -444,6 +445,17 @@ def build_get_routes(backend: ApiBackend):
         (re.compile(r"^/lighthouse/logs/tail$"),
          lambda m, q: {"data": global_log_buffer().tail(
              int(q.get("n", [100])[0]))}),
+        # -- graftscope tracing (obs/; see OBSERVABILITY.md) ----------------
+        # the bare endpoint serves the Chrome trace-event document itself
+        # (save it, load at ui.perfetto.dev / chrome://tracing)
+        (re.compile(r"^/lighthouse/tracing$"),
+         lambda m, q: obs.chrome_trace()),
+        (re.compile(r"^/lighthouse/tracing/spans$"),
+         lambda m, q: {"data": [s.to_json() for s in obs.snapshot()]}),
+        (re.compile(r"^/lighthouse/tracing/summary$"),
+         lambda m, q: {"data": obs.summarize_spans(obs.snapshot())}),
+        (re.compile(r"^/lighthouse/tracing/jax$"),
+         lambda m, q: {"data": obs.jax_counters()}),
     ]
 
 

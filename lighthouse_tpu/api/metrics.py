@@ -1,10 +1,14 @@
 """Prometheus metrics (lighthouse_metrics + http_metrics equivalent).
 
-A global registry with the reference's metric-name conventions; scrape server
-on demand. Uses prometheus_client (baked in)."""
+A global registry with the reference's metric-name conventions; scrape
+server on demand.  Uses prometheus_client when present; when it is
+absent every helper (including the ``timer``/``start_timer`` hot-path
+instrumentation) is a TRUE no-op — no lock, no dict churn, no exception
+— so instrumented library code costs nothing on a bare interpreter."""
 from __future__ import annotations
 
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 try:
@@ -14,6 +18,7 @@ try:
     _HAVE_PROM = True
 except Exception:  # pragma: no cover
     _HAVE_PROM = False
+    Counter = Gauge = Histogram = None
 
 REGISTRY = CollectorRegistry() if _HAVE_PROM else None
 _metrics: dict[str, object] = {}
@@ -21,30 +26,32 @@ _lock = threading.Lock()
 
 
 def _get(kind, name: str, help_: str, **kw):
+    if not _HAVE_PROM:
+        return None
     with _lock:
         m = _metrics.get(name)
-        if m is None and _HAVE_PROM:
+        if m is None:
             m = kind(name, help_, registry=REGISTRY, **kw)
             _metrics[name] = m
         return m
 
 
 def inc_counter(name: str, help_: str = "", amount: float = 1) -> None:
-    m = _get(Counter, name, help_ or name)
-    if m is not None:
-        m.inc(amount)
+    if not _HAVE_PROM:
+        return
+    _get(Counter, name, help_ or name).inc(amount)
 
 
 def set_gauge(name: str, value: float, help_: str = "") -> None:
-    m = _get(Gauge, name, help_ or name)
-    if m is not None:
-        m.set(value)
+    if not _HAVE_PROM:
+        return
+    _get(Gauge, name, help_ or name).set(value)
 
 
 def observe(name: str, value: float, help_: str = "") -> None:
-    m = _get(Histogram, name, help_ or name)
-    if m is not None:
-        m.observe(value)
+    if not _HAVE_PROM:
+        return
+    _get(Histogram, name, help_ or name).observe(value)
 
 
 class MetricsServer:
@@ -89,19 +96,43 @@ class timer:
 
         with metrics.timer("beacon_block_processing_seconds"):
             ...
-    """
+
+    Also usable as an explicit handle via :func:`start_timer`.  When
+    prometheus is absent, enter/exit never reads the clock and never
+    touches the registry."""
+
+    __slots__ = ("name", "help_", "_t0")
 
     def __init__(self, name: str, help_: str = ""):
         self.name = name
         self.help_ = help_
+        self._t0: float | None = None
 
     def __enter__(self):
-        import time
-        self._t0 = time.perf_counter()
+        if _HAVE_PROM:
+            self._t0 = time.perf_counter()
         return self
 
+    def observe_duration(self) -> None:
+        """Record the elapsed time since start (once; lighthouse's
+        StartedTimer::observe_duration)."""
+        if self._t0 is not None:
+            observe(self.name, time.perf_counter() - self._t0,
+                    self.help_ or self.name)
+            self._t0 = None
+
+    stop = observe_duration
+
     def __exit__(self, *exc):
-        import time
-        observe(self.name, time.perf_counter() - self._t0,
-                self.help_ or self.name)
+        self.observe_duration()
         return False
+
+
+def start_timer(name: str, help_: str = "") -> timer:
+    """lighthouse_metrics::start_timer: returns a started handle whose
+    ``observe_duration()``/``stop()`` records into the histogram.  A
+    dropped handle records nothing (unlike the Rust drop-guard, Python
+    finalization is not prompt enough to be a timing primitive)."""
+    t = timer(name, help_)
+    t.__enter__()
+    return t

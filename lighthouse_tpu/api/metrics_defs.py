@@ -130,6 +130,36 @@ CATALOG: dict[str, tuple[str, str]] = {
     "process_resident_memory_bytes": ("gauge", "RSS"),
     "system_load_1m": ("gauge", "1-minute load average"),
     "system_disk_free_bytes": ("gauge", "Free disk on the data volume"),
+    # -- graftscope tracing (obs/) ----------------------------------------
+    "beacon_block_pipeline_seconds":
+        ("hist", "Gossip arrival -> imported, whole pipeline trace"),
+    "beacon_processor_work_seconds":
+        ("hist", "Beacon-processor work item execution latency"),
+    "bench_stage_seconds":
+        ("hist", "bench.py --trace per-stage latency"),
+    # -- JAX runtime accounting (obs/jax_accounting) ----------------------
+    "jax_compile_total":
+        ("counter", "XLA programs compiled at runtime (recompile storms "
+                    "show here; the static complement is graftlint's "
+                    "recompile-hazard rule)"),
+    "jax_compile_seconds_total":
+        ("counter", "Seconds spent in XLA compilation at runtime"),
+    "jax_transfer_host_to_device_bytes_total":
+        ("counter", "Accounted host->device bytes (mesh.shard_batch)"),
+    "jax_transfer_device_to_host_bytes_total":
+        ("counter", "Accounted device->host bytes (obs.host_readback)"),
+    "jax_jit_cache_entries":
+        ("gauge", "Trace-cache entries of the last tracked jit program"),
+}
+
+#: Histograms declared for dashboard parity but fed outside the node
+#: process (tier-1's catalog-completeness test accepts these).  Keyed by
+#: name with the feeding agent as the justification.
+EXTERNALLY_FED: dict[str, str] = {
+    "bls_device_pairing_seconds":
+        "observed by the TPU bench harness (bench.py bls mode), which is "
+        "the only place the device pairing check runs end-to-end with a "
+        "meaningful batch on real hardware",
 }
 
 

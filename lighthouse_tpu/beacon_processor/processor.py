@@ -8,6 +8,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from ..obs import tracing
+
 
 class WorkType(enum.Enum):
     # priority order (beacon_processor/src/lib.rs queue drain order)
@@ -53,6 +55,9 @@ class Work:
     kind: WorkType
     run: Callable[[], Any]
     batchable_payload: Any = None  # set for attestation work, enables batching
+    #: (trace_id, span_id) captured at submit time so the worker's spans
+    #: join the submitting thread's trace (graftscope queue-hop rule)
+    trace_ctx: Any = None
 
 
 class BeaconProcessor:
@@ -99,6 +104,8 @@ class BeaconProcessor:
             self._workers.join_all(timeout=2)
 
     def submit(self, work: Work) -> bool:
+        if work.trace_ctx is None:
+            work.trace_ctx = tracing.capture()
         with self._lock:
             q = self.queues[work.kind]
             cap = self.caps.get(work.kind, 4096)
@@ -107,6 +114,10 @@ class BeaconProcessor:
                 q.popleft()
                 self.dropped += 1
             q.append(work)
+            pending = sum(len(qq) for qq in self.queues.values())
+        from ..api import metrics_defs as M
+        M.count("beacon_processor_work_events_total")
+        M.gauge("beacon_processor_queue_length", pending)
         self._event.set()
         return True
 
@@ -137,6 +148,22 @@ class BeaconProcessor:
                                 name="beacon_processor.worker")
 
     def _execute(self, work) -> None:
+        first = work[0] if isinstance(work, list) else work
+        batch = len(work) if isinstance(work, list) else 1
+        from ..api import metrics_defs as M
+        idle = getattr(self._idle, "_value", None)
+        if idle is not None:
+            M.gauge("beacon_processor_workers_active",
+                    self.num_workers - idle)
+        # re-attach the submitter's trace so the queue hop doesn't break
+        # the block's gossip->db-write trace; batches adopt the first
+        # item's context (they are one fused device call anyway)
+        with tracing.attach(first.trace_ctx), \
+                tracing.span("processor_work", work_kind=first.kind.name,
+                             batch=batch):
+            self._execute_inner(work)
+
+    def _execute_inner(self, work) -> None:
         try:
             if isinstance(work, list):
                 kind = work[0].kind

@@ -88,6 +88,9 @@ class ReprocessQueue:
                     self._by_root_count -= len(bucket)
         for w in due:
             self._submit(w)
+        if due:
+            from ..api import metrics_defs as M
+            M.count("beacon_processor_reprocess_total", len(due))
         with self._lock:
             self.replayed_total += len(due)
         return len(due)
@@ -98,6 +101,9 @@ class ReprocessQueue:
             self._by_root_count -= len(due)
         for w in due:
             self._submit(w)
+        if due:
+            from ..api import metrics_defs as M
+            M.count("beacon_processor_reprocess_total", len(due))
         with self._lock:
             self.replayed_total += len(due)
         return len(due)

@@ -13,6 +13,7 @@ import hashlib
 from dataclasses import dataclass
 
 from ..crypto import bls
+from ..obs import tracing
 from ..specs.chain_spec import ForkName, compute_domain, compute_signing_root
 from ..specs.constants import (
     DOMAIN_AGGREGATE_AND_PROOF, DOMAIN_BEACON_ATTESTER,
@@ -180,11 +181,12 @@ def finalize_unaggregated(chain, attestation, indexed,
 def verify_unaggregated_for_gossip(chain, attestation,
                                    subnet_id: int | None = None
                                    ) -> VerifiedUnaggregatedAttestation:
-    indexed, state, s = verify_unaggregated_checks(chain, attestation,
-                                                   subnet_id)
-    if not bls.verify_signature_sets([s]):
-        raise AttestationError(BAD_SIGNATURE, "attestation signature")
-    return finalize_unaggregated(chain, attestation, indexed, subnet_id)
+    with tracing.span("attestation_verify"):
+        indexed, state, s = verify_unaggregated_checks(chain, attestation,
+                                                       subnet_id)
+        if not bls.verify_signature_sets([s]):
+            raise AttestationError(BAD_SIGNATURE, "attestation signature")
+        return finalize_unaggregated(chain, attestation, indexed, subnet_id)
 
 
 def batch_verify_unaggregated_for_gossip(chain, attestations: list
@@ -192,6 +194,11 @@ def batch_verify_unaggregated_for_gossip(chain, attestations: list
     """Batch path (batch.rs:133): one multi-set verification; on failure,
     falls back to per-attestation verification. Returns a list of
     VerifiedUnaggregatedAttestation | AttestationError."""
+    with tracing.span("attestation_verify", batch=len(attestations)):
+        return _batch_verify_unaggregated(chain, attestations)
+
+
+def _batch_verify_unaggregated(chain, attestations: list) -> list:
     prepared = []
     results: list = [None] * len(attestations)
     for i, (att, subnet) in enumerate(attestations):
@@ -289,14 +296,20 @@ def finalize_aggregated(chain, signed_aggregate,
 
 def verify_aggregated_for_gossip(chain, signed_aggregate
                                  ) -> VerifiedAggregatedAttestation:
-    indexed, sets = verify_aggregated_checks(chain, signed_aggregate)
-    if not bls.verify_signature_sets(sets):
-        raise AttestationError(BAD_SIGNATURE, "aggregate signatures")
-    return finalize_aggregated(chain, signed_aggregate, indexed)
+    with tracing.span("aggregate_verify"):
+        indexed, sets = verify_aggregated_checks(chain, signed_aggregate)
+        if not bls.verify_signature_sets(sets):
+            raise AttestationError(BAD_SIGNATURE, "aggregate signatures")
+        return finalize_aggregated(chain, signed_aggregate, indexed)
 
 
 def batch_verify_aggregated_for_gossip(chain, aggregates: list) -> list:
     """Batch aggregates: 3 sets each, one verification (batch.rs:28)."""
+    with tracing.span("aggregate_verify", batch=len(aggregates)):
+        return _batch_verify_aggregated(chain, aggregates)
+
+
+def _batch_verify_aggregated(chain, aggregates: list) -> list:
     prepared = []
     results: list = [None] * len(aggregates)
     for i, agg in enumerate(aggregates):

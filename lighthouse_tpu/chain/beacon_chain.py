@@ -21,6 +21,7 @@ import numpy as np
 from ..containers import get_types
 from ..containers.state import BeaconState
 from ..crypto import bls
+from ..obs import tracing
 from ..fork_choice import ForkChoice
 from ..operation_pool import OperationPool
 from ..specs.chain_spec import ChainSpec, ForkName
@@ -73,6 +74,8 @@ class BeaconChain:
         self.T = get_types(spec.preset)
         self.store = store
         self.slot_clock = slot_clock
+        # trace roots are slot-anchored against this clock (obs/)
+        tracing.set_slot_clock(slot_clock)
         self.execution_layer = execution_layer
         self.config = config or ChainConfig()
 
@@ -284,8 +287,9 @@ class BeaconChain:
     def process_block(self, signed_block,
                       proposal_already_verified: bool = False) -> bytes:
         """Full import pipeline (beacon_chain.rs:3089): signatures (batched)
-        -> state transition -> payload -> fork choice -> store -> head."""
-        from ..api import metrics_defs as M
+        -> state transition -> payload -> fork choice -> store -> head.
+        Every stage is a graftscope span (obs/), so the call is one trace
+        AND feeds the stage histograms of the metrics catalog."""
         block = signed_block.message
         block_root = htr(block)
         if self.fork_choice.contains_block(block_root):
@@ -293,15 +297,26 @@ class BeaconChain:
         if not self.fork_choice.contains_block(block.parent_root):
             raise BlockError(PARENT_UNKNOWN, block.parent_root.hex())
         self.block_times_cache.on_observed(block_root, block.slot)
-        with M.timed("beacon_block_processing_seconds"):
-            with M.timed("beacon_block_processing_signature_seconds"):
+        with tracing.span("block_import", slot=int(block.slot),
+                          block_root=block_root.hex()):
+            with tracing.span("batch_signature"):
                 sv = blk_verify.into_signature_verified(
                     self, signed_block, block_root,
                     proposal_already_verified)
-            with M.timed(
-                    "beacon_block_processing_state_transition_seconds"):
-                ep = blk_verify.into_execution_pending(self, sv)
+            # state_transition + state_root spans live inside
+            ep = blk_verify.into_execution_pending(self, sv)
             return self._finish_process_block(block, block_root, ep)
+
+    def process_gossip_block(self, signed_block) -> bytes:
+        """Canonical gossip entry: gossip verification + full import as
+        ONE trace (the network service's inline path and the tracing
+        tier-1 gate both use this), rooted at a slot-anchored
+        block_pipeline span."""
+        with tracing.span("block_pipeline",
+                          slot=int(signed_block.message.slot)):
+            self.verify_block_for_gossip(signed_block)
+            return self.process_block(signed_block,
+                                      proposal_already_verified=True)
 
     def _finish_process_block(self, block, block_root: bytes, ep) -> bytes:
         # deneb+: blob availability gate (data_availability_checker.rs)
@@ -439,32 +454,37 @@ class BeaconChain:
         self.block_times_cache.on_imported(block_root, block.slot)
         M.count("beacon_block_imported_total")
         with self._lock:
-            self.fork_choice.on_block(current_slot, block, block_root, state,
-                                      block_delay_seconds=delay,
-                                      execution_status=status)
-            # on-block attestations feed LMD votes (is_from_block)
-            indexed_atts = []
-            for att in block.body.attestations:
-                try:
-                    indexed = get_indexed_attestation(state, att)
-                    indexed_atts.append(indexed)
-                    self.fork_choice.on_attestation(current_slot, indexed,
-                                                    is_from_block=True)
-                except Exception as e:  # votes are best-effort, but loudly
-                    import logging
-                    logging.getLogger("lighthouse_tpu.chain").warning(
-                        "on-block attestation skipped in fork choice: %r", e)
-            self.validator_monitor.on_block_imported(block, indexed_atts)
+            with tracing.span("fork_choice"):
+                self.fork_choice.on_block(current_slot, block, block_root,
+                                          state, block_delay_seconds=delay,
+                                          execution_status=status)
+                # on-block attestations feed LMD votes (is_from_block)
+                indexed_atts = []
+                for att in block.body.attestations:
+                    try:
+                        indexed = get_indexed_attestation(state, att)
+                        indexed_atts.append(indexed)
+                        self.fork_choice.on_attestation(
+                            current_slot, indexed, is_from_block=True)
+                    except Exception as e:  # best-effort, but loudly
+                        import logging
+                        logging.getLogger("lighthouse_tpu.chain").warning(
+                            "on-block attestation skipped in fork choice: "
+                            "%r", e)
+                for slashing in block.body.attester_slashings:
+                    self.fork_choice.on_attester_slashing(
+                        slashing.attestation_1)
+            self.validator_monitor.on_block_imported(block, indexed_atts,
+                                                     block_root=block_root)
             if state.current_epoch() > self._monitored_epoch:
                 self._monitored_epoch = state.current_epoch()
                 self.validator_monitor.on_epoch_transition(
                     self._monitored_epoch - 1, state)
             self.validator_monitor.note_state(state)
-            for slashing in block.body.attester_slashings:
-                self.fork_choice.on_attester_slashing(slashing.attestation_1)
-            self.store.put_block(block_root, ep.signed_block)
-            self.store.put_state(block.state_root, state)
-            self._cache_snapshot(block_root, state)
+            with tracing.span("db_write"):
+                self.store.put_block(block_root, ep.signed_block)
+                self.store.put_state(block.state_root, state)
+                self._cache_snapshot(block_root, state)
             try:
                 # serve attestations for this block state-free from now on
                 # (early_attester_cache.rs:1-30, attester_cache.rs:1-60)
@@ -624,9 +644,10 @@ class BeaconChain:
                     hasattr(fin_block.message.body, "execution_payload"):
                 fin_hash = \
                     fin_block.message.body.execution_payload.block_hash
-            self.execution_layer.notify_forkchoice_updated(
-                head_state.latest_execution_payload_header.block_hash,
-                fin_hash, fin_hash)
+            with tracing.span("el_forkchoice"):
+                self.execution_layer.notify_forkchoice_updated(
+                    head_state.latest_execution_payload_header.block_hash,
+                    fin_hash, fin_hash)
         return head_root
 
     _last_pruned_finalized = 0
@@ -746,6 +767,8 @@ class BeaconChain:
         with self._lock:
             self.fork_choice.on_attestation(self.slot(), verified.indexed,
                                             is_from_block=False)
+        from ..api import metrics_defs as M
+        M.count("beacon_attestations_imported_total")
 
     def add_to_op_pool(self, verified_attestation) -> None:
         att = getattr(verified_attestation, "attestation", None)
@@ -808,6 +831,18 @@ class BeaconChain:
         """3-phase production (beacon_chain.rs:4810): (1) state advance +
         op-pool packing, (2) payload retrieval, (3) completion + state root.
         Returns (block, post_state)."""
+        from ..api import metrics_defs as M
+        with tracing.span("block_production", slot=int(slot)):
+            out = self._produce_block_inner(
+                randao_reveal, slot, graffiti, skip_randao_verification,
+                sync_aggregate)
+        M.count("beacon_block_production_total")
+        return out
+
+    def _produce_block_inner(self, randao_reveal: bytes, slot: int,
+                             graffiti: bytes | None,
+                             skip_randao_verification: bool,
+                             sync_aggregate):
         if graffiti is None:
             graffiti = self.default_graffiti
         parent_root = self.get_proposer_head(slot)

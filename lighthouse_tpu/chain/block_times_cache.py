@@ -14,6 +14,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ..api import metrics_defs
+from ..obs import tracing
 
 MAX_ENTRIES = 64
 
@@ -57,6 +58,9 @@ class BlockTimesCache:
                 e.observed_delay = max(0.0, now - self._slot_start(slot))
                 metrics_defs.observe("beacon_block_observed_delay_seconds",
                                      e.observed_delay)
+                # anchor the active trace to the slot timeline
+                tracing.annotate(
+                    observed_delay_s=round(e.observed_delay, 6))
 
     def on_imported(self, root: bytes, slot: int,
                     now: float | None = None) -> None:
@@ -66,9 +70,12 @@ class BlockTimesCache:
             if e.imported_at is None:
                 e.imported_at = now
                 if e.observed_at is not None:
+                    imported_delay = max(0.0, now - e.observed_at)
                     metrics_defs.observe(
                         "beacon_block_imported_delay_seconds",
-                        max(0.0, now - e.observed_at))
+                        imported_delay)
+                    tracing.annotate(
+                        imported_delay_s=round(imported_delay, 6))
 
     def on_became_head(self, root: bytes, slot: int,
                        now: float | None = None) -> None:
@@ -78,9 +85,10 @@ class BlockTimesCache:
             if e.became_head_at is None:
                 e.became_head_at = now
                 if e.imported_at is not None:
+                    head_delay = max(0.0, now - e.imported_at)
                     metrics_defs.observe(
-                        "beacon_block_head_delay_seconds",
-                        max(0.0, now - e.imported_at))
+                        "beacon_block_head_delay_seconds", head_delay)
+                    tracing.annotate(head_delay_s=round(head_delay, 6))
 
     def get(self, root: bytes) -> BlockTimes | None:
         with self._lock:

@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..crypto import bls
+from ..obs import tracing
 from ..specs.chain_spec import ForkName
 from ..ssz import htr
 from ..state_transition import (
@@ -62,7 +63,13 @@ class ExecutionPendingBlock:
 def verify_block_for_gossip(chain, signed_block) -> GossipVerifiedBlock:
     block = signed_block.message
     block_root = htr(block)
+    with tracing.span("gossip_verify", slot=int(block.slot)):
+        return _verify_block_for_gossip(chain, signed_block, block,
+                                        block_root)
 
+
+def _verify_block_for_gossip(chain, signed_block, block,
+                             block_root: bytes) -> GossipVerifiedBlock:
     current_slot = chain.slot()
     disparity_slots = 0  # MAXIMUM_GOSSIP_CLOCK_DISPARITY folded into slot 0
     if block.slot > current_slot + disparity_slots:
@@ -152,19 +159,24 @@ def into_execution_pending(chain, sv: SignatureVerifiedBlock
                            ) -> ExecutionPendingBlock:
     block = sv.signed_block.message
     state = sv.state
-    try:
-        per_block_processing(state, sv.signed_block, VerifySignatures.FALSE,
-                             block_root=sv.block_root)
-    except BlockProcessingError as e:
-        raise BlockError(INVALID_BLOCK, str(e)) from e
-    if block.state_root != state.hash_tree_root():
+    with tracing.span("state_transition"):
+        try:
+            per_block_processing(state, sv.signed_block,
+                                 VerifySignatures.FALSE,
+                                 block_root=sv.block_root)
+        except BlockProcessingError as e:
+            raise BlockError(INVALID_BLOCK, str(e)) from e
+    with tracing.span("state_root"):
+        computed_root = state.hash_tree_root()
+    if block.state_root != computed_root:
         raise BlockError(INVALID_BLOCK, "state root mismatch")
 
     payload_status = "irrelevant"
     if state.fork_name >= ForkName.BELLATRIX and \
             hasattr(block.body, "execution_payload"):
-        payload_status = chain.execution_layer.notify_new_payload(
-            block.body.execution_payload)
+        with tracing.span("el_new_payload"):
+            payload_status = chain.execution_layer.notify_new_payload(
+                block.body.execution_payload)
         if payload_status == "invalid":
             from .errors import EXECUTION_INVALID
             raise BlockError(EXECUTION_INVALID, "EL rejected payload")

@@ -198,9 +198,11 @@ class DataAvailabilityChecker:
         body_root = sidecar.signed_block_header.message.body_root
         if not verify_commitment_inclusion(self.T, sidecar, body_root):
             return False
-        return self.kzg.verify_blob_kzg_proof_batch(
-            [bytes(sidecar.blob)], [sidecar.kzg_commitment],
-            [sidecar.kzg_proof])
+        from ..obs import tracing
+        with tracing.span("kzg_verify", index=int(sidecar.index)):
+            return self.kzg.verify_blob_kzg_proof_batch(
+                [bytes(sidecar.blob)], [sidecar.kzg_commitment],
+                [sidecar.kzg_proof])
 
     def contains_sidecar(self, block_root: bytes, index: int) -> bool:
         with self._lock:

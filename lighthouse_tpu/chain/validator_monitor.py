@@ -21,6 +21,9 @@ class EpochSummary:
     inclusion_distance_sum: int = 0
     blocks_proposed: int = 0
     sync_signatures: int = 0
+    #: summed delay-from-slot-start of this validator's observed proposals
+    #: (slot-anchored lateness, fed from the block-times cache)
+    block_delay_sum: float = 0.0
 
 
 class ValidatorMonitor:
@@ -40,12 +43,30 @@ class ValidatorMonitor:
 
     # -- feeds (called from import paths) ------------------------------------
 
-    def on_block_imported(self, block, indexed_attestations) -> None:
+    def on_block_imported(self, block, indexed_attestations,
+                          block_root: bytes | None = None) -> None:
         epoch = block.slot // self.chain.spec.preset.slots_per_epoch
         if self._tracked(block.proposer_index):
-            self.summaries[epoch][block.proposer_index].blocks_proposed += 1
-            log.info("validator %d proposed block at slot %d",
-                     block.proposer_index, block.slot)
+            s = self.summaries[epoch][block.proposer_index]
+            s.blocks_proposed += 1
+            # slot-anchored proposal lateness from the block-times cache:
+            # a monitored proposer landing past the attestation deadline
+            # (seconds_per_slot / 3) is the re-org-bait signal
+            delay = None
+            if block_root is not None:
+                bt = self.chain.block_times_cache.get(block_root)
+                if bt is not None:
+                    delay = bt.observed_delay
+            if delay is not None:
+                s.block_delay_sum += delay
+                deadline = self.chain.spec.seconds_per_slot / 3
+                lvl = log.warning if delay > deadline else log.info
+                lvl("validator %d proposed block at slot %d "
+                    "(%.3fs into the slot)",
+                    block.proposer_index, block.slot, delay)
+            else:
+                log.info("validator %d proposed block at slot %d",
+                         block.proposer_index, block.slot)
         for indexed in indexed_attestations:
             distance = block.slot - indexed.data.slot
             att_epoch = indexed.data.slot // \

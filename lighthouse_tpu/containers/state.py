@@ -929,9 +929,14 @@ class BeaconState:
         return cache.hash_tree_root(f.limit)
 
     def hash_tree_root(self) -> bytes:
-        specs = active_field_specs(self.T, self.fork_name)
-        roots = [self._field_root(f) for f in specs]
-        return merkleize_chunks(roots, 1 << (len(roots) - 1).bit_length())
+        # graftscope: the state root is a north-star hot spot — every
+        # computation lands in tree_hash_root_seconds and the active trace
+        from ..obs import tracing
+        with tracing.span("tree_hash", slot=int(self.slot)):
+            specs = active_field_specs(self.T, self.fork_name)
+            roots = [self._field_root(f) for f in specs]
+            return merkleize_chunks(roots,
+                                    1 << (len(roots) - 1).bit_length())
 
     # -- serialization -------------------------------------------------------
     def _field_serialize(self, f: FieldSpec) -> tuple[bytes, bool]:

@@ -258,17 +258,18 @@ def aggregate_verify(pks, msgs, sig) -> bool:
 
 
 def verify_signature_sets(sets: list[SignatureSet]) -> bool:
-    # hot-path timing (beacon_chain/src/metrics.rs style); recorded only
-    # when the metrics module is live, so library use stays weightless
+    # hot-path tracing (beacon_chain/src/metrics.rs style): the span
+    # joins whatever trace is active (block import, attestation batch)
+    # and feeds the CATALOG histograms — obs stays weightless for
+    # library use (its metrics feed is sys.modules-gated)
+    from ...obs import tracing
+    with tracing.span("bls_batch_verify", sets=len(sets)):
+        out = get_backend().verify_signature_sets(sets)
     import sys
-    import time
-    t0 = time.perf_counter()
-    out = get_backend().verify_signature_sets(sets)
-    m = sys.modules.get("lighthouse_tpu.api.metrics")
+    m = sys.modules.get("lighthouse_tpu.api.metrics_defs")
     if m is not None:
-        m.observe("bls_batch_verify_seconds", time.perf_counter() - t0)
-        m.observe("bls_batch_verify_set_count", len(sets))
-        m.inc_counter("bls_batch_verify_total")
+        m.observe("beacon_batch_verify_signature_sets", len(sets))
+        m.observe("bls_batch_verify_sigs", len(sets))
     return out
 
 

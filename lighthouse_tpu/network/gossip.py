@@ -26,8 +26,18 @@ import struct
 import threading
 from collections import OrderedDict
 
+from ..obs import tracing
 from . import gossipsub_pb as pb
 from . import snappy
+
+
+def _count(name: str, amount: float = 1) -> None:
+    """Catalog counter, sys.modules-gated (wire tests run the engine
+    without the metrics stack)."""
+    import sys
+    md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+    if md is not None:
+        md.count(name, amount)
 
 MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
 MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
@@ -209,6 +219,7 @@ class GossipEngine:
         mid = self._message_id(topic, data)
         self._mark_seen(mid)
         self._cache_put(mid, topic, data)
+        _count("gossipsub_messages_published_total")
         framed = pb.frame(pb.Rpc(publish=[self._pub_msg(topic, data)]))
         with self._lock:
             members = set(self.mesh.get(topic, ()))
@@ -283,7 +294,9 @@ class GossipEngine:
             return             # before decompression: no CPU for spam topics
         data = snappy.decompress_block(msg.data, self.MAX_PAYLOAD)
         mid = self._message_id(topic, data)
+        _count("gossipsub_messages_received_total")
         if self._mark_seen(mid):
+            _count("gossipsub_duplicates_dropped_total")
             return
         self._cache_put(mid, topic, data)
         if len(data) >= self.IDONTWANT_THRESHOLD:
@@ -296,12 +309,22 @@ class GossipEngine:
                 idontwant=[pb.ControlIWant([mid])]))
             for pid in others:
                 self._send_rpc_id(pid, idw)
-        result, ctx = self.validator(topic, data)
-        self.on_validation_result(peer, topic, result)
-        if result == "accept":
-            # forward to the topic mesh only (gossipsub), never flood
-            self.publish(topic, data, exclude_peer=peer.node_id)
-            self.on_message(topic, data, peer, ctx)
+            if others:
+                _count("gossipsub_idontwant_sent_total", len(others))
+        # one slot-anchored trace per block message: validation (which
+        # runs gossip_verify) and delivery (which submits processor work
+        # carrying this context) share the trace id, so the block's path
+        # from wire to db-write is a single graftscope trace
+        is_block = topic == "beacon_block"
+        with tracing.span("block_pipeline", topic=topic) if is_block \
+                else tracing.attach(None):
+            result, ctx = self.validator(topic, data)
+            _count(f"gossipsub_validation_{result}_total")
+            self.on_validation_result(peer, topic, result)
+            if result == "accept":
+                # forward to the topic mesh only (gossipsub), never flood
+                self.publish(topic, data, exclude_peer=peer.node_id)
+                self.on_message(topic, data, peer, ctx)
 
     def _handle_graft(self, peer, topic_str: str) -> None:
         topic = self._bare(peer, topic_str)

@@ -1,0 +1,23 @@
+"""graftscope — structured tracing + JAX runtime accounting (L9).
+
+See OBSERVABILITY.md for the span taxonomy, the ``/lighthouse/tracing``
+endpoint, the Perfetto export workflow and the compile/transfer
+counters.  Everything here is stdlib-only at import time.
+"""
+from .jax_accounting import (
+    account_transfer, host_readback, install_monitoring, snapshot as
+    jax_counters, track_compiles,
+)
+from .report import render_table, summarize_chrome, summarize_spans
+from .tracing import (
+    SPAN_KINDS, Span, annotate, attach, capture, chrome_trace, clear,
+    current_context, current_span, set_slot_clock, snapshot, span,
+)
+
+__all__ = [
+    "SPAN_KINDS", "Span", "annotate", "attach", "capture", "chrome_trace",
+    "clear", "current_context", "current_span", "set_slot_clock",
+    "snapshot", "span", "account_transfer", "host_readback",
+    "install_monitoring", "jax_counters", "track_compiles",
+    "render_table", "summarize_chrome", "summarize_spans",
+]

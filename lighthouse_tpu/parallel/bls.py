@@ -22,6 +22,7 @@ try:                              # jax >= 0.4.35 exports it at top level
 except ImportError:               # older jax: experimental location
     from jax.experimental.shard_map import shard_map
 
+from ..obs.jax_accounting import host_readback, track_compiles
 from ..ops.bls12_381 import (
     final_exponentiation,
     fp12_eq,
@@ -50,34 +51,36 @@ def _local_masked_product(lpx, lpy, lqx, lqy, lmask):
 # Memoized jitted programs per (mesh, axis): a fresh jit(shard_map(...))
 # per call would rebuild the wrapper — and the shard_map closure under it
 # — every time, so every call re-traced (graftlint: recompile-hazard).
+# track_compiles() is the dynamic complement: a shape leak past the
+# memoization shows up as jax_compile_total, not a silent re-trace.
 
 @functools.lru_cache(maxsize=None)
 def _miller_product_fn(mesh: Mesh, axis: str):
-    return jax.jit(shard_map(
+    return track_compiles("bls.miller_product", jax.jit(shard_map(
         _local_miller_product, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=P(axis)))
+        out_specs=P(axis))))
 
 
 @functools.lru_cache(maxsize=None)
 def _masked_product_fn(mesh: Mesh, axis: str):
-    return jax.jit(shard_map(
+    return track_compiles("bls.masked_product", jax.jit(shard_map(
         _local_masked_product, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
-        out_specs=P(axis)))
+        out_specs=P(axis))))
 
 
 @functools.lru_cache(maxsize=None)
 def _scalar_mul_fns(mesh: Mesh, axis: str):
     import lighthouse_tpu.ops.bls12_381 as k
-    g1 = jax.jit(shard_map(
+    g1 = track_compiles("bls.g1_scalar_mul", jax.jit(shard_map(
         k.g1_scalar_mul, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis))))
-    g2 = jax.jit(shard_map(
+        out_specs=(P(axis), P(axis), P(axis)))))
+    g2 = track_compiles("bls.g2_scalar_mul", jax.jit(shard_map(
         k.g2_scalar_mul, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis))))
+        out_specs=(P(axis), P(axis), P(axis)))))
     return g1, g2
 
 
@@ -156,11 +159,14 @@ def sharded_verify_signature_sets(mesh: Mesh, sets, lanes: int,
     import jax.numpy as jnp
     sig_x = jnp.asarray(prep["sig_x"])
     sig_y, on_curve = k.g2_decompress_batch(sig_x, prep["flags"])
-    if not bool(np.asarray(on_curve).all()):
+    # validity gates are the two deliberate mid-pipeline host round-trips;
+    # host_readback() is the sanctioned (byte-accounted) crossing — the
+    # device-transfer lint rule rejects bare np.asarray here
+    if not bool(host_readback(on_curve).all()):
         return False
     one2 = jnp.asarray(np.broadcast_to(k.FP2_ONE, (lanes, 2, bi.NLIMBS)))
-    if not bool(np.asarray(k.g2_in_subgroup_batch(sig_x, sig_y,
-                                                  one2)).all()):
+    if not bool(host_readback(k.g2_in_subgroup_batch(sig_x, sig_y,
+                                                     one2)).all()):
         return False
     mx, my, mz = k.hash_to_g2_batch_from_u(prep["u0"], prep["u1"])
     msg_x, msg_y = k.jacobian_to_affine_fp2(mx, my, mz)
@@ -206,4 +212,4 @@ def sharded_verify_signature_sets(mesh: Mesh, sets, lanes: int,
     partials = _masked_product_fn(mesh, axis)(px, py, qx, qy,
                                               jnp.asarray(full_mask))
     out = final_exponentiation(fp12_product(partials))
-    return bool(np.asarray(fp12_eq(out[None], fp12_one_like((1,)))[0]))
+    return bool(host_readback(fp12_eq(out[None], fp12_one_like((1,)))[0]))

@@ -19,6 +19,7 @@ try:                              # jax >= 0.4.35 exports it at top level
 except ImportError:               # older jax: experimental location
     from jax.experimental.shard_map import shard_map
 
+from ..obs.jax_accounting import track_compiles
 from ..ops.sha256 import hash_pairs, merkleize_dense
 
 
@@ -38,7 +39,8 @@ def _sharded_merkleize_fn(mesh: Mesh, subtree_depth: int, top_depth: int,
                           axis: str):
     """Memoized jitted program per (mesh, depths): a fresh
     jit(shard_map(...)) per call would re-trace every call
-    (graftlint: recompile-hazard)."""
+    (graftlint: recompile-hazard).  track_compiles() makes any leak past
+    the memoization an observable jax_compile_total increment."""
     fn = shard_map(
         functools.partial(_subtree_then_top, subtree_depth=subtree_depth,
                           top_depth=top_depth, axis=axis),
@@ -46,7 +48,8 @@ def _sharded_merkleize_fn(mesh: Mesh, subtree_depth: int, top_depth: int,
         in_specs=(P(axis, None),),
         out_specs=P(axis, None),
     )
-    return jax.jit(fn)
+    return track_compiles(
+        f"merkle.subtree_d{subtree_depth}_t{top_depth}", jax.jit(fn))
 
 
 def sharded_merkleize(mesh: Mesh, leaves: jax.Array,

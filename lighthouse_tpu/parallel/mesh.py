@@ -15,6 +15,10 @@ def batch_mesh(n_devices: int | None = None,
 
 
 def shard_batch(mesh: Mesh, arr, axis: str = "batch"):
-    """Place an array row-sharded over the mesh's batch axis."""
+    """Place an array row-sharded over the mesh's batch axis (the
+    sanctioned, byte-accounted host->device crossing — the
+    device-transfer lint rule flags bare placements)."""
+    from ..obs.jax_accounting import account_transfer
+    account_transfer(getattr(arr, "nbytes", 0), "h2d")
     sharding = NamedSharding(mesh, P(axis))
     return jax.device_put(arr, sharding)

@@ -12,10 +12,12 @@ Equivalent of /root/reference/beacon_node/store/src/hot_cold_store.rs:50:
 from __future__ import annotations
 
 import struct
+import sys
 from dataclasses import dataclass
 
 from ..containers import get_types
 from ..containers.state import BeaconState
+from ..obs import tracing
 from ..specs.chain_spec import ChainSpec, ForkName
 from ..ssz import deserialize, htr, serialize
 from .kv import KeyValueStore, StoreError
@@ -33,6 +35,14 @@ METADATA = b"m:"
 ITEM = b"i:"                   # generic persisted items (fork choice, op pool)
 
 SCHEMA_VERSION = 2             # v2: chunked freezer root vectors
+
+
+def _count(name: str, amount: float = 1) -> None:
+    """Catalog counter, sys.modules-gated so standalone store use stays
+    metrics-free (same discipline as obs.tracing)."""
+    md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+    if md is not None:
+        md.count(name, amount)
 
 
 @dataclass
@@ -128,6 +138,7 @@ class HotColdDB:
         data = bytes([fork.value]) + serialize(
             type(signed_block).ssz_type, signed_block)
         self.hot.put(BLOCK + block_root, data)
+        _count("store_hot_db_ops_total")
 
     def get_block(self, block_root: bytes):
         raw = self.hot.get(BLOCK + block_root)
@@ -176,6 +187,7 @@ class HotColdDB:
         summary = struct.pack("<Q", state.slot) + latest_block_root \
             + boundary_root
         self.hot.put(HOT_STATE_SUMMARY + state_root, summary)
+        _count("store_hot_db_ops_total")
 
     @staticmethod
     def _latest_block_root(state: BeaconState) -> bytes:
@@ -268,6 +280,7 @@ class HotColdDB:
 
     def freezer_put_block_root(self, slot: int, block_root: bytes) -> None:
         self.block_roots.put(slot, block_root)
+        _count("store_cold_db_ops_total")
 
     def freezer_block_root_at_slot(self, slot: int) -> bytes | None:
         return self.block_roots.get(slot)
@@ -281,13 +294,16 @@ class HotColdDB:
     def freezer_put_state(self, slot: int, state: BeaconState) -> None:
         data = bytes([state.fork_name.value]) + state.serialize()
         self.cold.put(FREEZER_STATE + struct.pack(">Q", slot), data)
+        _count("store_cold_db_ops_total")
 
     def load_cold_state_by_slot(self, slot: int) -> BeaconState | None:
         """Nearest restore point at/below `slot` + block replay, behind
         the bounded state cache (state_cache.rs role)."""
         cached = self.state_cache.get(("cold", slot))
         if cached is not None:
+            _count("store_state_cache_hits_total")
             return cached.copy()
+        _count("store_state_cache_misses_total")
         srp = self.config.slots_per_restore_point
         rp_slot = (slot // srp) * srp
         raw = None
@@ -303,19 +319,21 @@ class HotColdDB:
         state = BeaconState.from_ssz_bytes(raw[1:], self.T, self.spec,
                                            ForkName(raw[0]))
         if state.slot != slot:
-            blocks = []
-            seen = None
-            for s, root in self.block_roots.range(state.slot + 1,
-                                                  slot + 1):
-                if root is None or root == seen:
-                    continue  # skipped slot (same root repeated)
-                seen = root
-                blk = self.get_block(root)
-                if blk is not None and blk.message.slot > state.slot:
-                    blocks.append(blk)
-            from ..state_transition import BlockReplayer
-            state = BlockReplayer(state).apply_blocks(blocks,
-                                                      target_slot=slot)
+            with tracing.span("cold_state_replay", target_slot=int(slot),
+                              from_slot=int(state.slot)):
+                blocks = []
+                seen = None
+                for s, root in self.block_roots.range(state.slot + 1,
+                                                      slot + 1):
+                    if root is None or root == seen:
+                        continue  # skipped slot (same root repeated)
+                    seen = root
+                    blk = self.get_block(root)
+                    if blk is not None and blk.message.slot > state.slot:
+                        blocks.append(blk)
+                from ..state_transition import BlockReplayer
+                state = BlockReplayer(state).apply_blocks(blocks,
+                                                          target_slot=slot)
         self.state_cache.put(("cold", slot), state)
         return state.copy()
 
@@ -345,6 +363,19 @@ class HotColdDB:
         split (store/src/migrate.rs + hot_cold_store.rs migration)."""
         if finalized_slot <= self.split.slot:
             return
+        with tracing.span("store_migration",
+                          finalized_slot=int(finalized_slot)):
+            self._migrate_database(finalized_slot, finalized_state_root,
+                                   finalized_block_root, canonical_roots,
+                                   abandoned_block_roots,
+                                   abandoned_state_roots)
+
+    def _migrate_database(self, finalized_slot: int,
+                          finalized_state_root: bytes,
+                          finalized_block_root: bytes,
+                          canonical_roots: dict[int, bytes],
+                          abandoned_block_roots: list[bytes] = (),
+                          abandoned_state_roots: list[bytes] = ()) -> None:
         srp = self.config.slots_per_restore_point
         for slot in range(self.split.slot, finalized_slot + 1):
             root = canonical_roots.get(slot)

@@ -37,6 +37,16 @@ class LogBuffer(logging.Handler):
             }
         except Exception:
             return
+        # stamp the active graftscope trace so /lighthouse/logs output is
+        # correlatable with /lighthouse/tracing spans (best-effort: a log
+        # record must never be lost to tracing trouble)
+        try:
+            from ..obs.tracing import current_context
+            ctx = current_context()
+            if ctx is not None:
+                entry["trace_id"], entry["span_id"] = ctx
+        except Exception:
+            pass
         with self._lock:
             self.records.append(entry)
             for q in self._subs:

@@ -29,7 +29,17 @@ class ThreadGroup:
 
     def spawn(self, target, *args, name: str | None = None,
               daemon: bool = True) -> threading.Thread:
-        t = threading.Thread(target=target, args=args, name=name,
+        # propagate the spawner's trace context so spans opened in the
+        # child join the same trace (graftscope cross-thread rule; the
+        # beacon processor's Work items do the same for queue hops)
+        from ..obs import tracing
+        ctx = tracing.capture()
+        run = target
+        if ctx is not None:
+            def run(*a, _target=target, _ctx=ctx):
+                with tracing.attach(_ctx):
+                    _target(*a)
+        t = threading.Thread(target=run, args=args, name=name,
                              daemon=daemon)
         self.track(t)
         t.start()

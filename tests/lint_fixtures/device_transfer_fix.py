@@ -1,0 +1,78 @@
+"""Seeded fixture for the device-transfer rule.
+
+The module imports jax.sharding, which puts it in the rule's scope.
+Every true-positive line carries a ``seeded`` marker; the true-negatives
+below (explicit NamedSharding placement, pure host numpy work, the
+sanctioned host_readback crossing) must stay silent.  This file is never
+imported, only AST-scanned.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import lighthouse_tpu.ops.sha256 as k
+from lighthouse_tpu.ops.bls12_381 import fp12_eq
+
+
+def bad_bare_put(arr):
+    return jax.device_put(arr)  # seeded
+
+
+def bad_roundtrip(x):
+    y = jnp.square(x)
+    return np.asarray(y)  # seeded
+
+
+def bad_transitive(x):
+    y = jnp.add(x, 1)
+    z = y + 2
+    return np.array(z)  # seeded
+
+
+def bad_factory_output(factory, mesh, x):
+    out = factory(mesh)(x)
+    return np.asarray(out)  # seeded
+
+
+def bad_ops_alias(x):
+    pairs = k.hash_pairs(x)
+    return np.asarray(pairs)  # seeded
+
+
+def bad_ops_from_import(a, b):
+    return np.asarray(fp12_eq(a, b))  # seeded
+
+
+def bad_device_get(x):
+    y = jnp.abs(x)
+    return jax.device_get(y)  # seeded
+
+
+# -- true negatives ----------------------------------------------------------
+
+def good_sharded_put(arr, mesh):
+    # explicit placement is the point of device_put at a shard boundary
+    return jax.device_put(arr, NamedSharding(mesh, P("batch")))
+
+
+def good_kwarg_put(arr, sharding):
+    return jax.device_put(arr, device=sharding)
+
+
+def good_host_data(n):
+    # numpy on host data is not a round-trip
+    devs = list(range(n))
+    table = np.array(devs)
+    return np.asarray(table)
+
+
+def good_readback(x):
+    from lighthouse_tpu.obs.jax_accounting import host_readback
+    y = jnp.square(x)
+    return bool(host_readback(y).all())
+
+
+def good_device_value_stays_on_device(x):
+    y = jnp.square(x)
+    return y + 1

@@ -62,3 +62,30 @@ def good_kernel(x):
     local.append(k)            # mutating a LOCAL is not a side effect
     jax.debug.print("rows={r}", r=rows)   # the sanctioned debug path
     return x * scale
+
+
+def span(kind):
+    # local stand-in for lighthouse_tpu.obs.tracing.span: its internals
+    # (perf_counter etc.) must NOT taint jit-reachable callers — the
+    # rule treats span()/annotate() call names as sanctioned non-effects
+    # and never follows the call edge
+    t0 = time.perf_counter()
+    return t0
+
+
+def annotate(**kw):
+    time.monotonic()
+    return kw
+
+
+def good_host_wrapper(x):
+    # jit-reachable through dispatch() below, but the graftscope calls
+    # are sanctioned: no violation on this path
+    span("kernel")
+    annotate(rows=1)
+    return x
+
+
+@jax.jit
+def dispatch(x):
+    return good_host_wrapper(x)

@@ -34,6 +34,7 @@ RULE_FIXTURE = {
     "thread-lifecycle": "thread_lifecycle_fix.py",
     "spec-constant-drift": "spec_constant_drift_fix.py",
     "ssz-schema": "ssz_schema_fix.py",
+    "device-transfer": "device_transfer_fix.py",
 }
 
 
@@ -43,7 +44,7 @@ def _seeded_lines(path: Path) -> list[int]:
                   if "# seeded" in line)
 
 
-def test_registry_has_all_six_rules():
+def test_registry_has_all_rules():
     assert set(RULE_FIXTURE) <= set(all_rules())
 
 

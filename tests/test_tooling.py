@@ -191,7 +191,8 @@ def test_watch_http_server_and_metrics_timers():
                                             b"m")])
     from prometheus_client import generate_latest
     text = generate_latest(metrics.REGISTRY).decode()
-    assert "bls_batch_verify_seconds" in text
+    assert "beacon_batch_verify_seconds" in text
+    assert "beacon_batch_verify_signature_sets" in text
     assert "validator_registry_tree_hash_seconds" in text
     with metrics.timer("unit_test_timer_seconds"):
         pass

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""graftscope trace report — per-stage latency table from a trace.
+
+    python tools/trace/report.py trace.json          # Chrome trace file
+    curl -s $BN/lighthouse/tracing | python tools/trace/report.py -
+    python tools/trace/report.py --json trace.json   # machine-readable
+
+Accepts the Chrome trace-event document served by /lighthouse/tracing
+(or written by `bench.py --trace`), or the {"data": [span...]} form of
+/lighthouse/tracing/spans.  Prints count / p50 / p95 / max / total per
+stage, widest-total first.  Exit codes: 0 ok, 2 unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+from lighthouse_tpu.obs.report import (  # noqa: E402
+    render_table, summarize_chrome, summarize_durations,
+)
+
+
+def summarize_any(doc) -> dict:
+    """Summary from either supported document shape."""
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        return summarize_chrome(doc)
+    spans = doc.get("data", doc) if isinstance(doc, dict) else doc
+    by_stage: dict[str, list[float]] = {}
+    for s in spans:
+        by_stage.setdefault(s.get("kind", "?"), []).append(
+            float(s.get("dur_s", 0.0)))
+    return summarize_durations(by_stage)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="trace file, or '-' for stdin")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    try:
+        raw = sys.stdin.read() if args.path == "-" else \
+            Path(args.path).read_text()
+        doc = json.loads(raw)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"unreadable trace input: {e}", file=sys.stderr)
+        return 2
+    summary = summarize_any(doc)
+    print(json.dumps(summary, indent=2) if args.json
+          else render_table(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
