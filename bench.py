@@ -198,6 +198,167 @@ def bench_mont_mul_modes():
     return out
 
 
+def build_beacon_state(n, slot):
+    """Full altair BeaconState with n validators, built column-wise (no
+    per-deposit genesis — that is O(n) python loops).  Participation is
+    shaped like a live mainnet epoch: previous epoch fully attested,
+    current epoch attested for the slots already elapsed."""
+    import numpy as np
+    from lighthouse_tpu.containers import get_types
+    from lighthouse_tpu.containers.state import BeaconState
+    from lighthouse_tpu.specs.chain_spec import ForkName, mainnet_spec
+    spec = mainnet_spec()
+    T = get_types(spec.preset)
+    state = BeaconState(T, spec, ForkName.ALTAIR)
+    rng = np.random.default_rng(7)
+    vr, balances = build_state_columns(n)
+    # ETH1-credential prefix so the (capella+) withdrawal sweep has real
+    # matches; harmless pre-capella
+    vr.withdrawal_credentials[:, 0] = 0x01
+    state.validators = vr
+    state.balances = balances
+    state.slot = slot
+    epoch = slot // T.preset.slots_per_epoch
+    state.fork = T.Fork(previous_version=spec.altair_fork_version,
+                        current_version=spec.altair_fork_version,
+                        epoch=0)
+    state.latest_block_header = T.BeaconBlockHeader(
+        slot=slot - 1, proposer_index=0, parent_root=b"\x11" * 32,
+        state_root=b"\x22" * 32, body_root=b"\x33" * 32)
+    state.block_roots = rng.integers(
+        0, 256, size=state.block_roots.shape, dtype=np.uint8)
+    state.state_roots = rng.integers(
+        0, 256, size=state.state_roots.shape, dtype=np.uint8)
+    state.randao_mixes = rng.integers(
+        0, 256, size=state.randao_mixes.shape, dtype=np.uint8)
+    state.previous_epoch_participation = np.full(n, 0b0111, np.uint8)
+    cur = np.zeros(n, np.uint8)
+    elapsed = slot % T.preset.slots_per_epoch
+    attested = rng.random(n) < elapsed / T.preset.slots_per_epoch
+    cur[attested] = 0b0111
+    state.current_epoch_participation = cur
+    state.inactivity_scores = np.zeros(n, np.uint64)
+    state.previous_justified_checkpoint = T.Checkpoint(
+        epoch=epoch - 2, root=b"\x44" * 32)
+    state.current_justified_checkpoint = T.Checkpoint(
+        epoch=epoch - 1, root=b"\x55" * 32)
+    state.finalized_checkpoint = T.Checkpoint(
+        epoch=epoch - 2, root=b"\x44" * 32)
+    state.justification_bits = [True, True, True, True]
+    pubkeys = [bytes(vr.pubkeys[i]) for i in range(
+        T.preset.sync_committee_size)]
+    state.current_sync_committee = T.SyncCommittee(
+        pubkeys=pubkeys, aggregate_pubkey=pubkeys[0])
+    state.next_sync_committee = T.SyncCommittee(
+        pubkeys=pubkeys, aggregate_pubkey=pubkeys[0])
+    return state
+
+
+def _build_import_block(state):
+    """A block at state.slot with full attestation coverage of the prior
+    slot and a full sync aggregate — the per-slot worst case the STF
+    envelope must absorb.  Signatures are structurally valid (the fake
+    backend accepts them); the record labels sig_backend honestly."""
+    from lighthouse_tpu.specs.chain_spec import ForkName
+    from lighthouse_tpu.ssz import htr
+    from lighthouse_tpu.state_transition.helpers import (
+        committee_cache, get_beacon_proposer_index,
+    )
+    T = state.T
+    slot = state.slot
+    epoch = state.current_epoch()
+    cache = committee_cache(state, epoch)
+    att_slot = slot - 1
+    target_root = state.get_block_root(epoch)
+    head_root = state.get_block_root_at_slot(att_slot)
+    data_tpl = dict(
+        slot=att_slot, beacon_block_root=head_root,
+        source=state.current_justified_checkpoint,
+        target=T.Checkpoint(epoch=epoch, root=target_root))
+    sig = b"\x80" + b"\x00" * 95
+    attestations = []
+    for index in range(cache.committees_per_slot):
+        committee = cache.committee(att_slot, index)
+        attestations.append(T.Attestation(
+            aggregation_bits=[True] * len(committee),
+            data=T.AttestationData(index=index, **data_tpl),
+            signature=sig))
+    sync_aggregate = T.SyncAggregate(
+        sync_committee_bits=[True] * T.preset.sync_committee_size,
+        sync_committee_signature=sig)
+    proposer = get_beacon_proposer_index(state)
+    body = T.BeaconBlockBody[ForkName.ALTAIR](
+        randao_reveal=sig, eth1_data=state.eth1_data,
+        graffiti=b"\x00" * 32, attestations=attestations)
+    body.sync_aggregate = sync_aggregate
+    block = T.BeaconBlock[ForkName.ALTAIR](
+        slot=slot, proposer_index=proposer,
+        parent_root=htr(state.latest_block_header),
+        state_root=b"\x00" * 32, body=body)
+    return T.SignedBeaconBlock[ForkName.ALTAIR](message=block,
+                                                signature=sig)
+
+
+def bench_state_transition():
+    """Mainnet-envelope STF: per_epoch_processing and full-block
+    per_block_processing at N_VALIDATORS on the mainnet preset.  Pure
+    host/numpy path (no jax imports beyond the platform label)."""
+    from lighthouse_tpu import obs
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_transition import (
+        VerifySignatures, per_block_processing, per_epoch_processing,
+    )
+    n = int(os.environ.get("LHTPU_BENCH_STF_N", N_VALIDATORS))
+    # mid-epoch slot far from a sync-committee-period boundary, so the
+    # epoch number is realistic but the timed epoch never pays the
+    # (cached-in-practice) next-sync-committee sampling
+    slot = 100_000 * 32 + 2
+    bls.set_backend("fake")
+    state = build_beacon_state(n, slot)
+    state.validators.index_of(bytes(state.validators.pubkeys[0]))
+    sb = _build_import_block(state)
+
+    stages = {}
+    t0 = time.perf_counter()
+    pre = state.copy()
+    stages["state_copy_ms"] = (time.perf_counter() - t0) * 1000
+
+    # untimed warmup: faults the copied columns in, and primes the
+    # shared shuffling cache + pubkey index for every timed rep
+    t0 = time.perf_counter()
+    per_block_processing(pre.copy(), sb, VerifySignatures.FALSE)
+    stages["block_warmup_ms"] = round((time.perf_counter() - t0) * 1000, 2)
+
+    block_ms = {}
+    for label, vs in (("signatures_off", VerifySignatures.FALSE),
+                      ("signatures_on", VerifySignatures.TRUE)):
+        best = float("inf")
+        for _ in range(2):
+            st = pre.copy()
+            t0 = time.perf_counter()
+            with obs.span("stf_block", slot=int(sb.message.slot)):
+                per_block_processing(st, sb, vs)
+            best = min(best, (time.perf_counter() - t0) * 1000)
+        block_ms[label] = round(best, 2)
+    stages["committees_per_slot"] = \
+        len(sb.message.body.attestations)
+
+    ep = pre.copy()
+    ep.slot = (slot // 32) * 32 + 31        # epoch boundary semantics
+    t0 = time.perf_counter()
+    with obs.span("stf_epoch", epoch=int(ep.current_epoch()),
+                  n_validators=n):
+        per_epoch_processing(ep)
+    epoch_ms = (time.perf_counter() - t0) * 1000
+    return {
+        "epoch_ms": round(epoch_ms, 1),
+        "block_import_ms": block_ms,
+        "n_validators": n,
+        "sig_backend": "fake",
+        "stages": stages,
+    }
+
+
 def _measured_host_baseline():
     """Measured single-pairing-check cost on the native C++ backend, scaled
     to the reference's 4-core node.  Returns (sigs_per_sec, source) where
@@ -252,6 +413,23 @@ def child_main():
             "baseline_sigs_per_sec": round(baseline, 1),
             "baseline_source": baseline_source,
             "n_sigs": n_sigs,
+        }
+    elif mode == "stf":
+        stf = bench_state_transition()
+        off = stf["block_import_ms"]["signatures_off"]
+        rec = {
+            "metric": "stf_mainnet_envelope_1m_validators",
+            "value": stf["epoch_ms"],
+            "unit": "ms",
+            # north star: one epoch inside the 12 s slot budget
+            "vs_baseline": round(12_000.0 / max(stf["epoch_ms"], 1e-9), 3),
+            "platform": platform,
+            "epoch_ms_1m": stf["epoch_ms"],
+            "block_import_ms_1m": stf["block_import_ms"],
+            "block_import_ms_1m_headline": off,
+            "n_validators": stf["n_validators"],
+            "sig_backend": stf["sig_backend"],
+            "stf_stages": stf["stages"],
         }
     elif mode == "mxu":
         mm = bench_mont_mul_modes()
@@ -350,6 +528,61 @@ def _bls_record(tree_hash_was_cpu: bool):
             os.environ["LHTPU_BENCH"] = prev
 
 
+def _stf_record(force_cpu: bool):
+    """One bounded child for the mainnet-envelope STF numbers.  The
+    workload is host/numpy, so it always runs forced-CPU — a wedged TPU
+    tunnel must never cost the state-transition record."""
+    if os.environ.get("LHTPU_BENCH_STF", "1") == "0":
+        return None
+    prev = os.environ.get("LHTPU_BENCH")
+    os.environ["LHTPU_BENCH"] = "stf"
+    try:
+        rec, _ = _try_child(True, int(os.environ.get(
+            "LHTPU_BENCH_STF_TIMEOUT", 900)))
+        return rec
+    finally:
+        if prev is None:
+            del os.environ["LHTPU_BENCH"]
+        else:
+            os.environ["LHTPU_BENCH"] = prev
+
+
+_PROBE_STAGES = [("import", "import jax"),
+                 ("devices", "import jax; jax.devices()")]
+
+
+def tpu_probe(timeout=90):
+    """Staged TPU-acquisition probe (satellite): how far does JAX get on
+    this host, under default init and under JAX_PLATFORMS=tpu?  Each
+    stage is its own subprocess with a hard timeout, so a wedged libtpu
+    acquisition can't hang the bench — the record says exactly which
+    stage died and how long it took."""
+    out = {"timeout_s": timeout}
+    for label, extra in (("default", {}),
+                         ("forced_tpu", {"JAX_PLATFORMS": "tpu"})):
+        env = _child_env(force_cpu=False)
+        env.pop("LHTPU_BENCH_CHILD", None)
+        env.update(extra)
+        stage_reached = None
+        stages = {}
+        for stage, code in _PROBE_STAGES:
+            stage_reached = stage
+            t0 = time.perf_counter()
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", code], env=env, cwd=_REPO,
+                    capture_output=True, text=True, timeout=timeout)
+                rc = proc.returncode
+            except subprocess.TimeoutExpired:
+                rc = None
+            wall = round(time.perf_counter() - t0, 2)
+            stages[stage] = {"wall_s": wall, "rc": rc}
+            if rc != 0:
+                break
+        out[label] = {"stage_reached": stage_reached, "stages": stages}
+    return out
+
+
 def _mxu_record(force_cpu: bool):
     """One bounded child for the MXU-mode mont_mul measurement — runs
     LAST so its cold compiles can never cost the flagship records."""
@@ -403,18 +636,32 @@ def main():
                     rec["bls_n_sigs"] = bls_rec.get("n_sigs")
                     rec["bls_baseline_source"] = \
                         bls_rec.get("baseline_source")
+                stf_rec = _stf_record(force_cpu)
+                if stf_rec is not None and stf_rec.get("value"):
+                    rec["epoch_ms_1m"] = stf_rec["epoch_ms_1m"]
+                    rec["block_import_ms_1m"] = \
+                        stf_rec["block_import_ms_1m"]
+                    rec["stf_n_validators"] = \
+                        stf_rec.get("n_validators")
+                    rec["stf_sig_backend"] = stf_rec.get("sig_backend")
+                    rec["stf_stages"] = stf_rec.get("stf_stages")
                 mxu_rec = _mxu_record(force_cpu)
                 if mxu_rec is not None and mxu_rec.get("value"):
                     rec["mont_mul_per_sec"] = \
                         mxu_rec.get("mont_mul_per_sec")
                     rec["mxu_mode_speedup"] = mxu_rec["value"]
                     rec["mxu_platform"] = mxu_rec.get("platform")
+                if os.environ.get("LHTPU_BENCH_PROBE", "1") != "0":
+                    rec["tpu_probe"] = tpu_probe()
             print(json.dumps(rec))
             return
         errors.append(("cpu" if force_cpu else "default") + ": " + err)
-    metric = ("bls_batch_verify_throughput"
-              if os.environ.get("LHTPU_BENCH") == "bls"
-              else "beacon_state_tree_hash_1m_validators")
+    metric = {
+        "bls": "bls_batch_verify_throughput",
+        "stf": "stf_mainnet_envelope_1m_validators",
+        "mxu": "mont_mul_mxu_modes",
+    }.get(os.environ.get("LHTPU_BENCH", "tree_hash"),
+          "beacon_state_tree_hash_1m_validators")
     print(json.dumps({
         "metric": metric,
         "value": None, "unit": "error", "vs_baseline": 0.0,

@@ -361,20 +361,26 @@ class ApiBackend:
         return block
 
     def attestation_data(self, slot: int, committee_index: int):
+        from ..state_transition.helpers import (
+            StateError, get_committee_count_per_slot,
+        )
         chain = self.chain
-        # fast path 1: the early-attester cache serves the current head
-        # state-free (early_attester_cache.rs:1-30)
-        early = chain.early_attester_cache.try_attest(chain, slot,
-                                                      committee_index)
-        if early is not None:
-            return early
-        # fast path 2: non-head slots whose epoch is decided — source
-        # checkpoint from the attester cache, roots from fork choice; no
-        # state read or replay (attester_cache.rs:1-60)
-        cached = chain.attester_cache.attestation_data(chain, slot,
-                                                       committee_index)
-        if cached is not None:
-            return cached
+        try:
+            # fast path 1: the early-attester cache serves the current
+            # head state-free (early_attester_cache.rs:1-30)
+            early = chain.early_attester_cache.try_attest(chain, slot,
+                                                          committee_index)
+            if early is not None:
+                return early
+            # fast path 2: non-head slots whose epoch is decided — source
+            # checkpoint from the attester cache, roots from fork choice;
+            # no state read or replay (attester_cache.rs:1-60)
+            cached = chain.attester_cache.attestation_data(chain, slot,
+                                                           committee_index)
+            if cached is not None:
+                return cached
+        except StateError as e:
+            raise ApiError(400, str(e))
         head = chain.head()
         st = head.head_state
         if st.slot < slot:
@@ -395,6 +401,11 @@ class ApiBackend:
             source = st.previous_justified_checkpoint
         else:
             raise ApiError(400, "attestation slot too old to produce")
+        cps = get_committee_count_per_slot(st, epoch)
+        if committee_index >= cps:
+            raise ApiError(400, f"committee index {committee_index} out "
+                                f"of range (epoch {epoch} has {cps} "
+                                "committees per slot)")
         epoch_start = compute_start_slot_at_epoch(epoch, spe)
         if head.head_state.slot <= epoch_start:
             target_root = head.head_block_root
@@ -778,10 +789,16 @@ class ApiBackend:
             return {"peer_id": "0" * 16, "enr": "",
                     "p2p_addresses": [], "discovery_addresses": [],
                     "metadata": {"seq_number": "0",
-                                 "attnets": "0x" + "00" * 8}}
+                                 "attnets": "0x" + "00" * 8,
+                                 "syncnets": "0x00"}}
         attnets = 0
         for subnet in getattr(net, "attnet_subnets", []):
             attnets |= 1 << subnet
+        # syncnets mirrors attnets: a 1-byte LE bitfield of the
+        # sync-committee subnets this node serves (metadata v2)
+        syncnets = 0
+        for subnet in getattr(net, "syncnet_subnets", []):
+            syncnets |= 1 << subnet
         enr_text, disc_addrs, seq = "", [], 1
         if disc is not None:
             enr_text = disc.enr.to_text()
@@ -795,7 +812,9 @@ class ApiBackend:
             "discovery_addresses": disc_addrs,
             "metadata": {"seq_number": str(seq),
                          "attnets": "0x" + attnets.to_bytes(
-                             8, "little").hex()}}
+                             8, "little").hex(),
+                         "syncnets": "0x" + syncnets.to_bytes(
+                             1, "little").hex()}}
 
     def node_peers(self, states: list | None = None,
                    directions: list | None = None) -> list[dict]:

@@ -137,6 +137,11 @@ CATALOG: dict[str, tuple[str, str]] = {
         ("hist", "Beacon-processor work item execution latency"),
     "bench_stage_seconds":
         ("hist", "bench.py --trace per-stage latency"),
+    "stf_epoch_seconds":
+        ("hist", "per_epoch_processing wall time (epoch boundary in the "
+                 "node, 1M-validator envelope in bench.py stf mode)"),
+    "stf_block_seconds":
+        ("hist", "per_block_processing wall time for one imported block"),
     # -- JAX runtime accounting (obs/jax_accounting) ----------------------
     "jax_compile_total":
         ("counter", "XLA programs compiled at runtime (recompile storms "

@@ -568,8 +568,10 @@ class BeaconChain:
                 if state.slot < block.slot:
                     process_slots(state, block.slot)
                 try:
-                    per_block_processing(state, sb, VerifySignatures.FALSE,
-                                         block_root=root)
+                    with tracing.span("stf_block", slot=int(block.slot)):
+                        per_block_processing(state, sb,
+                                             VerifySignatures.FALSE,
+                                             block_root=root)
                 except BlockProcessingError as e:
                     raise BlockError(INVALID_BLOCK, str(e)) from e
                 if block.state_root != state.hash_tree_root():

@@ -26,8 +26,9 @@ from collections import OrderedDict
 
 from ..state_transition import process_slots
 from ..state_transition.helpers import (
-    CommitteeCache, committee_cache, compute_epoch_at_slot,
+    CommitteeCache, StateError, committee_cache, compute_epoch_at_slot,
     compute_start_slot_at_epoch, get_beacon_proposer_index,
+    get_committee_count_per_slot,
 )
 
 
@@ -143,14 +144,17 @@ class ProposerCache:
 
 
 class EarlyAttesterCacheEntry:
-    __slots__ = ("block_root", "slot", "epoch", "source", "target")
+    __slots__ = ("block_root", "slot", "epoch", "source", "target",
+                 "committees_per_slot")
 
-    def __init__(self, block_root, slot, epoch, source, target):
+    def __init__(self, block_root, slot, epoch, source, target,
+                 committees_per_slot):
         self.block_root = block_root
         self.slot = slot
         self.epoch = epoch
         self.source = source
         self.target = target
+        self.committees_per_slot = committees_per_slot
 
 
 class EarlyAttesterCache:
@@ -178,7 +182,8 @@ class EarlyAttesterCache:
                 block_root, block.slot, epoch,
                 (int(state.current_justified_checkpoint.epoch),
                  bytes(state.current_justified_checkpoint.root)),
-                (epoch, target_root))
+                (epoch, target_root),
+                get_committee_count_per_slot(state, epoch))
 
     def try_attest(self, chain, slot: int, committee_index: int):
         """AttestationData if the current head is the cached block and the
@@ -193,6 +198,11 @@ class EarlyAttesterCache:
         head_root = chain.head().head_block_root
         if head_root != e.block_root:
             return None
+        if committee_index >= e.committees_per_slot:
+            raise StateError(
+                f"committee index {committee_index} out of range "
+                f"(epoch {e.epoch} has {e.committees_per_slot} "
+                "committees per slot)")
         T = chain.T
         return T.AttestationData(
             slot=slot, index=committee_index,
@@ -206,20 +216,24 @@ class AttesterCache:
     the head chain WITHOUT any state read or replay
     (beacon_chain/src/attester_cache.rs:1-60).
 
-    The only state-derived field of AttestationData is the source
-    (justified) checkpoint, which is fixed per (epoch, decision_root)
-    where decision_root is the head-chain block root at the last slot of
-    the previous epoch; beacon_block_root and the target root come from
-    fork choice (proto-array ancestor walk).  Primed at block import and
-    by the state-advance timer; the state fallback path also primes it so
-    a given (epoch, chain) replays at most once.
+    The only state-derived fields of AttestationData are the source
+    (justified) checkpoint and the committee bound, both fixed per
+    (epoch, decision_root) where decision_root is the head-chain block
+    root at the last slot of the previous epoch; beacon_block_root and
+    the target root come from fork choice (proto-array ancestor walk).
+    Primed at block import and by the state-advance timer; the state
+    fallback path also primes it so a given (epoch, chain) replays at
+    most once.  A committee_index outside the epoch's committees-per-slot
+    raises StateError instead of silently serving data no committee can
+    sign (attester_cache.rs CommitteeLengths::get_committee_length).
     """
 
     SIZE = 16
 
     def __init__(self):
-        self._map: OrderedDict[tuple[int, bytes], tuple[int, bytes]] = \
-            OrderedDict()
+        # (epoch, droot) -> (src_epoch, src_root, committees_per_slot)
+        self._map: OrderedDict[tuple[int, bytes],
+                               tuple[int, bytes, int]] = OrderedDict()
         self._lock = threading.Lock()
 
     @staticmethod
@@ -237,7 +251,8 @@ class AttesterCache:
         except Exception:
             return                      # state too young for the lookup
         value = (int(state.current_justified_checkpoint.epoch),
-                 bytes(state.current_justified_checkpoint.root))
+                 bytes(state.current_justified_checkpoint.root),
+                 get_committee_count_per_slot(state, epoch))
         with self._lock:
             self._map[(epoch, droot)] = value
             self._map.move_to_end((epoch, droot))
@@ -264,6 +279,10 @@ class AttesterCache:
             value = self._map.get((epoch, droot))
         if value is None:
             return None
+        if committee_index >= value[2]:
+            raise StateError(
+                f"committee index {committee_index} out of range "
+                f"(epoch {epoch} has {value[2]} committees per slot)")
         # the LMD vote for slot S is the head-chain block AT/BELOW S —
         # voting the head itself for a past slot is rejected by fork
         # choice ("attestation for block newer than slot")

@@ -501,10 +501,16 @@ class BalancesColumn:
 
     def set_many(self, rows: np.ndarray, values: np.ndarray) -> None:
         self.values[rows] = values
+        self.mark_dirty_many(rows)
+
+    def mark_dirty_many(self, rows) -> None:
+        """Vector form of mark_dirty: one unique+divide over the row set
+        instead of a per-row set.add (the attestation hot path touches a
+        whole committee at once)."""
         self._root_cache = None
         if self._dirty_chunks is not None:
-            self._dirty_chunks.update(int(r) // self.per_chunk
-                                      for r in np.unique(rows))
+            chunks = np.unique(np.asarray(rows, np.int64) // self.per_chunk)
+            self._dirty_chunks.update(chunks.tolist())
 
     def set(self, i: int, value: int) -> None:
         self.values[i] = value
@@ -720,8 +726,7 @@ class BeaconState:
         discipline."""
         cache = self._curr_part_cache if current else self._prev_part_cache
         if cache is not None:
-            for i in indices:
-                cache.mark_dirty(int(i))
+            cache.mark_dirty_many(indices)
 
     def rotate_participation(self) -> None:
         """Epoch rotation: previous <- current with the primed tree

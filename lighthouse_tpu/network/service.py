@@ -47,6 +47,7 @@ class NetworkService:
         self.config = config or NetworkConfig()
         self.processor = processor
         self._threads = ThreadGroup("network_service")
+        self._stopping = False
         if processor is not None:
             processor.batch_handler = self._attestation_batch
             processor.start()
@@ -91,7 +92,10 @@ class NetworkService:
                                           (nid + 1) % n_subnets})
         for subnet in self.attnet_subnets:
             self.gossip.subscribe(Topic.attestation_subnet(subnet))
-        for subnet in range(4):
+        # all four sync-committee subnets (SYNC_COMMITTEE_SUBNET_COUNT);
+        # recorded so /eth/v1/node/identity can report syncnets honestly
+        self.syncnet_subnets = list(range(4))
+        for subnet in self.syncnet_subnets:
             self.gossip.subscribe(Topic.sync_subnet(subnet))
         # PeerDAS custody subnets derived from our authenticated node id
         from ..chain.data_columns import (
@@ -136,10 +140,13 @@ class NetworkService:
 
     def stop(self) -> None:
         # Shutdown ordering is structural (task_executor/src/lib.rs:12-28;
-        # round-5 leak, VERDICT §weak 2): first stop the things that
-        # CREATE work (heartbeat, sync downloads), then join the service
-        # threads that might be mid-request, then close the sockets they
-        # would have written to, and only then stop the work sink.
+        # round-5 leak, VERDICT §weak 2): first refuse new work (the
+        # _stopping flag parks status exchanges before they can call into
+        # a closing sync executor), then stop the things that CREATE work
+        # (heartbeat, sync downloads), then join the service threads that
+        # might be mid-request, then close the sockets they would have
+        # written to, and only then stop the work sink.
+        self._stopping = True
         self.gossip.stop(join=True)
         self.sync.stop()                    # no new download futures
         self._threads.join_all(timeout=3)   # status exchanges, timers
@@ -154,6 +161,8 @@ class NetworkService:
     # -- plumbing ------------------------------------------------------------
 
     def _on_peer(self, peer) -> None:
+        if self._stopping:
+            return
         self.peers.on_connect(peer.node_id)
         self.gossip.on_peer_connected(peer)
         self._threads.spawn(self._status_exchange, peer,
@@ -179,6 +188,8 @@ class NetworkService:
             head_slot=head.head_state.slot)
 
     def _status_exchange(self, peer) -> None:
+        if self._stopping:
+            return
         try:
             resp = self.rpc.request(peer, "status",
                                     self.local_status().to_json())
@@ -198,6 +209,10 @@ class NetworkService:
                 pass
             finally:
                 peer.close()
+            return
+        if self._stopping:
+            # stop() won the race while we waited on the exchange: don't
+            # kick a sync drive against the closed download executor
             return
         self.peers.set_status(peer.node_id, status)
         self.sync.maybe_sync()

@@ -167,19 +167,25 @@ class _RealSyncContext:
         return self._decode_block(resp[0])
 
     def send_range(self, peer_id: str, start: int, count: int, owner) -> int:
+        # submit BEFORE taking the lock (submission takes it internally),
+        # then allocate the id and record the request atomically: a
+        # concurrent close() can no longer observe the id without the
+        # inflight entry, and a post-close caller records the pre-failed
+        # future instead of racing `RuntimeError: cannot schedule new
+        # futures after shutdown` on a status-exchange thread
+        fut = self._submit(self._fetch_range, peer_id, start, count)
         with self._lock:
             req_id = self._next_req
             self._next_req += 1
-        fut = self._submit(self._fetch_range, peer_id, start, count)
-        self.inflight[req_id] = (owner, peer_id, fut, "range")
+            self.inflight[req_id] = (owner, peer_id, fut, "range")
         return req_id
 
     def send_root(self, peer_id: str, root: bytes, owner) -> int:
+        fut = self._submit(self._fetch_root, peer_id, root)
         with self._lock:
             req_id = self._next_req
             self._next_req += 1
-        fut = self._submit(self._fetch_root, peer_id, root)
-        self.inflight[req_id] = (owner, peer_id, fut, "root")
+            self.inflight[req_id] = (owner, peer_id, fut, "root")
         return req_id
 
     # -- event pump ----------------------------------------------------------

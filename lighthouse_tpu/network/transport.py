@@ -130,8 +130,11 @@ class Peer:
         try:
             supported = PROTO_MESHSUB + self.transport.rpc_protocols
             proto = ms.negotiate_in(StreamIO(stream), supported)
-        except (ms.MultistreamError, YamuxError):
-            stream.rst()
+        except (ms.MultistreamError, YamuxError, OSError):
+            try:
+                stream.rst()
+            except (YamuxError, OSError):
+                pass            # socket already gone at teardown
             return
         if proto in PROTO_MESHSUB:
             self._gossip_read_loop(stream)
@@ -142,7 +145,10 @@ class Peer:
                 import logging
                 logging.getLogger("lighthouse_tpu.network").exception(
                     "rpc stream handler failed (peer %s)", self.node_id)
-                stream.rst()
+                try:
+                    stream.rst()
+                except (YamuxError, OSError):
+                    pass
 
     def _gossip_read_loop(self, stream: Stream) -> None:
         from .gossipsub_pb import MAX_RPC_SIZE, PbError

@@ -141,7 +141,7 @@ class Stream:
             try:
                 self.session._send(encode_frame(TYPE_DATA, FLAG_FIN,
                                                 self.id))
-            except YamuxError:
+            except (YamuxError, OSError):
                 pass
         self.session._maybe_gc(self)
 
@@ -155,7 +155,7 @@ class Stream:
             self.cv.notify_all()
         try:
             self.session._send(encode_frame(TYPE_DATA, FLAG_RST, self.id))
-        except YamuxError:
+        except (YamuxError, OSError):
             pass
         self.session._maybe_gc(self)
 

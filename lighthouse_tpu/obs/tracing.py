@@ -64,6 +64,9 @@ SPAN_KINDS: dict[str, str] = {
     "el_forkchoice": "execution_layer_forkchoice_seconds",
     # bench harness stages (bench.py --trace)
     "bench_stage": "bench_stage_seconds",
+    # mainnet-envelope STF (slot.py epoch boundary, bench.py stf mode)
+    "stf_epoch": "stf_epoch_seconds",
+    "stf_block": "stf_block_seconds",
 }
 
 _RING_CAPACITY = 4096

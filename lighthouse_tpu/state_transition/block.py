@@ -339,20 +339,29 @@ def process_attestation(state: BeaconState, attestation,
         participation = state.current_epoch_participation
     else:
         participation = state.previous_epoch_participation
+    # Masked column ops over the SoA participation array: the scalar spec
+    # walks each attesting index and each flag; here one gather + one
+    # boolean mask per flag covers the whole committee.  Rewards stay
+    # exact: base_reward(i) = (eff[i] // increment) * base_per_increment,
+    # summed over indices whose flag was newly set, per flag weight.
     total_active = get_total_active_balance(state)
+    idx = np.asarray(indexed.attesting_indices, dtype=np.int64)
+    before = participation[idx].astype(np.int64)
+    base_rewards = (
+        state.validators.effective_balance[idx].astype(np.int64)
+        // p.effective_balance_increment) \
+        * get_base_reward_per_increment(state, total_active)
     proposer_reward_numerator = 0
-    touched = []
-    for index in indexed.attesting_indices:
-        current = int(participation[index])
-        for fi in flag_indices:
-            if not has_flag(current, fi):
-                current = add_flag(current, fi)
-                proposer_reward_numerator += get_base_reward_altair(
-                    state, index, total_active) * PARTICIPATION_FLAG_WEIGHTS[fi]
-        if current != int(participation[index]):
-            participation[index] = current
-            touched.append(index)
-    if touched:
+    after = before
+    for fi in flag_indices:
+        newly = (after & (1 << fi)) == 0
+        proposer_reward_numerator += int(base_rewards[newly].sum()) \
+            * PARTICIPATION_FLAG_WEIGHTS[fi]
+        after = after | (1 << fi)
+    changed = after != before
+    if changed.any():
+        touched = idx[changed]
+        participation[touched] = after[changed].astype(participation.dtype)
         state.mark_participation_dirty(
             touched, participation is state.current_epoch_participation)
     denom = (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR \
@@ -772,39 +781,49 @@ def get_expected_withdrawals(state: BeaconState):
                     amount=withdrawable))
                 withdrawal_index += 1
             processed_partials += 1
+    # Bounded vectorized sweep: evaluate the full/partial predicates for
+    # the whole window with column ops, then materialize only the (rare)
+    # candidates in sweep order.  Window positions are distinct validators
+    # (bound <= n), so a swept validator never re-sees its own appended
+    # withdrawal; only the pending-partial stage above affects `balance`.
     n = len(state.validators)
     bound = min(n, p.max_validators_per_withdrawals_sweep)
-    for _ in range(bound):
-        v = state.validators.view(validator_index)
-        balance = int(state.balances[validator_index])
-        if state.fork_name >= ForkName.ELECTRA:
-            partially_withdrawn = sum(
-                w.amount for w in withdrawals
-                if w.validator_index == validator_index)
-            balance -= partially_withdrawn
-            max_eb = (p.max_effective_balance_electra
-                      if has_compounding_withdrawal_credential(
-                          v.withdrawal_credentials)
-                      else p.min_activation_balance)
-        else:
-            max_eb = p.max_effective_balance
-        fully = (has_execution_withdrawal_credential(v.withdrawal_credentials)
-                 if state.fork_name >= ForkName.ELECTRA
-                 else has_eth1_withdrawal_credential(v.withdrawal_credentials))
-        if fully and v.withdrawable_epoch <= epoch and balance > 0:
-            withdrawals.append(T.Withdrawal(
-                index=withdrawal_index, validator_index=validator_index,
-                address=v.withdrawal_credentials[12:], amount=balance))
-            withdrawal_index += 1
-        elif fully and v.effective_balance == max_eb and balance > max_eb:
-            withdrawals.append(T.Withdrawal(
-                index=withdrawal_index, validator_index=validator_index,
-                address=v.withdrawal_credentials[12:],
-                amount=balance - max_eb))
-            withdrawal_index += 1
+    v = state.validators
+    electra = state.fork_name >= ForkName.ELECTRA
+    sweep = (validator_index + np.arange(bound, dtype=np.int64)) % n
+    prefix = v.withdrawal_credentials[sweep, 0]
+    balance = state.balances[sweep].astype(np.int64)
+    if electra:
+        partial_sums: dict[int, int] = {}
+        for w in withdrawals:
+            partial_sums[w.validator_index] = \
+                partial_sums.get(w.validator_index, 0) + w.amount
+        for vi, amount in partial_sums.items():
+            pos = (vi - validator_index) % n
+            if pos < bound:
+                balance[pos] -= amount
+        compounding = prefix == COMPOUNDING_WITHDRAWAL_PREFIX
+        max_eb_arr = np.where(compounding, p.max_effective_balance_electra,
+                              p.min_activation_balance).astype(np.int64)
+        fully_creds = (prefix == ETH1_ADDRESS_WITHDRAWAL_PREFIX) | compounding
+    else:
+        max_eb_arr = np.full(bound, p.max_effective_balance, np.int64)
+        fully_creds = prefix == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    full_w = fully_creds \
+        & (v.withdrawable_epoch[sweep] <= np.uint64(epoch)) & (balance > 0)
+    part_w = fully_creds & (v.effective_balance[sweep].astype(np.int64)
+                            == max_eb_arr) & (balance > max_eb_arr)
+    for pos in np.flatnonzero(full_w | part_w):
+        vi = int(sweep[pos])
+        wc = v.withdrawal_credentials[vi].tobytes()
+        amount = int(balance[pos]) if full_w[pos] \
+            else int(balance[pos] - max_eb_arr[pos])
+        withdrawals.append(T.Withdrawal(
+            index=withdrawal_index, validator_index=vi,
+            address=wc[12:], amount=amount))
+        withdrawal_index += 1
         if len(withdrawals) == p.max_withdrawals_per_payload:
             break
-        validator_index = (validator_index + 1) % n
     return withdrawals, processed_partials
 
 

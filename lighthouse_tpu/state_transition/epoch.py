@@ -423,15 +423,21 @@ def _process_pending_deposits(state: BeaconState) -> None:
     finalized_slot = compute_start_slot_at_epoch(
         state.finalized_checkpoint.epoch, state.slots_per_epoch)
     max_per_epoch = state.T.preset.max_pending_deposits_per_epoch
-    for deposit in state.pending_deposits:
-        # eth1-bridge deposits are processed in order with the bridge queue
-        if (state.deposit_requests_start_index != FAR_FUTURE_EPOCH
-                and deposit.slot > GENESIS_SLOT
-                and state.eth1_deposit_index <
-                state.deposit_requests_start_index):
-            break
-        if deposit.slot > finalized_slot:
-            break
+    # Bounded sweep: at most max_per_epoch entries are consumed per epoch,
+    # and the two slot gates are loop-invariant (nothing in this loop
+    # moves eth1_deposit_index or the finalized slot), so the stop point
+    # over the window is one vectorized scan instead of per-entry checks.
+    window = state.pending_deposits[:max_per_epoch + 1]
+    bridge_gated = (state.deposit_requests_start_index != FAR_FUTURE_EPOCH
+                    and state.eth1_deposit_index <
+                    state.deposit_requests_start_index)
+    slots = np.fromiter((int(d.slot) for d in window), np.int64, len(window))
+    gated = slots > finalized_slot
+    if bridge_gated:
+        gated |= slots > GENESIS_SLOT
+    stop = np.flatnonzero(gated)
+    limit = int(stop[0]) if stop.size else len(window)
+    for deposit in window[:limit]:
         if next_deposit_index >= max_per_epoch:
             break
         v_index = state.validators.index_of(deposit.pubkey)

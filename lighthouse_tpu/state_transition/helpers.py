@@ -8,6 +8,8 @@ from __future__ import annotations
 
 import hashlib
 import math
+import threading
+from collections import OrderedDict
 
 import numpy as np
 
@@ -128,13 +130,21 @@ class CommitteeCache:
     """Shuffling + committee layout for one epoch.
 
     Equivalent of consensus/types/src/beacon_state/committee_cache.rs.
+    The whole layout is precomputed: the shuffled vector plus the
+    committee boundary table, so `committee()` is two table lookups and a
+    slice. Instances are immutable after construction and shared across
+    states through the process-wide shuffling cache below.
     """
 
-    def __init__(self, state: BeaconState, epoch: int):
+    def __init__(self, state: BeaconState, epoch: int,
+                 active: np.ndarray | None = None,
+                 seed: bytes | None = None):
         p = state.T.preset
         self.epoch = epoch
-        self.active = get_active_validator_indices(state, epoch)
-        self.seed = get_seed(state, epoch, DOMAIN_BEACON_ATTESTER)
+        self.active = (active if active is not None
+                       else get_active_validator_indices(state, epoch))
+        self.seed = (seed if seed is not None
+                     else get_seed(state, epoch, DOMAIN_BEACON_ATTESTER))
         sigma = compute_shuffled_indices(
             len(self.active), self.seed, p.shuffle_round_count)
         self.shuffled = self.active[sigma]
@@ -142,18 +152,64 @@ class CommitteeCache:
             p.max_committees_per_slot,
             len(self.active) // p.slots_per_epoch // p.target_committee_size))
         self.slots_per_epoch = p.slots_per_epoch
+        count = self.committees_per_slot * self.slots_per_epoch
+        self._bounds = (len(self.shuffled)
+                        * np.arange(count + 1, dtype=np.int64)) // count
 
     def committee(self, slot: int, index: int) -> np.ndarray:
-        n = len(self.shuffled)
-        count = self.committees_per_slot * self.slots_per_epoch
         i = (slot % self.slots_per_epoch) * self.committees_per_slot + index
-        start = n * i // count
-        end = n * (i + 1) // count
-        return self.shuffled[start:end]
+        return self.shuffled[self._bounds[i]:self._bounds[i + 1]]
 
     def committees_at_slot(self, slot: int) -> list[np.ndarray]:
         return [self.committee(slot, i)
                 for i in range(self.committees_per_slot)]
+
+
+class _SharedShufflingCache:
+    """Process-wide (seed, epoch, n_active) -> CommitteeCache.
+
+    The per-state `_committee_caches` dict dies with its state: sibling
+    states, advanced clones, and replayed forks each re-shuffled the full
+    permutation for the SAME shuffling. The seed already commits to the
+    randao decision point, so it plays the role of the reference's
+    shuffling decision root (shuffle_cache.rs keying); the active-set
+    length rides in the key and the full active vector is confirmed on
+    hit before an entry is shared.
+    """
+
+    SIZE = 16
+
+    def __init__(self):
+        self._cache: OrderedDict[tuple, CommitteeCache] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple) -> CommitteeCache | None:
+        with self._lock:
+            cc = self._cache.get(key)
+            if cc is not None:
+                self._cache.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return cc
+
+    def insert(self, key: tuple, cc: CommitteeCache) -> None:
+        with self._lock:
+            self._cache[key] = cc
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.SIZE:
+                self._cache.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()
+            self.hits = 0
+            self.misses = 0
+
+
+shared_shufflings = _SharedShufflingCache()
 
 
 def committee_cache(state: BeaconState, epoch: int) -> CommitteeCache:
@@ -163,7 +219,15 @@ def committee_cache(state: BeaconState, epoch: int) -> CommitteeCache:
         state._committee_caches = caches
     c = caches.get(epoch)
     if c is None or c.epoch != epoch:
-        c = CommitteeCache(state, epoch)
+        active = get_active_validator_indices(state, epoch)
+        seed = get_seed(state, epoch, DOMAIN_BEACON_ATTESTER)
+        key = (seed, epoch, len(active))
+        c = shared_shufflings.get(key)
+        if c is not None and not np.array_equal(c.active, active):
+            c = None                    # seed collision across active sets
+        if c is None:
+            c = CommitteeCache(state, epoch, active=active, seed=seed)
+            shared_shufflings.insert(key, c)
         caches[epoch] = c
         # keep at most 3 epochs (previous, current, next)
         for k in sorted(caches):
@@ -184,8 +248,43 @@ def get_beacon_committee(state: BeaconState, slot: int,
 
 # -- proposer selection ------------------------------------------------------
 
+#: candidates sampled per batch round; a multiple of 32 (and 16) so draws
+#: stay digest-aligned for both the 1-byte and 2-byte randomness widths
+_SAMPLE_BATCH = 1024
+
+
+def _candidate_randomness(seed: bytes, i0: int, count: int,
+                          electra: bool) -> np.ndarray:
+    """Rejection-sampling draws r_i for candidates [i0, i0+count).
+
+    One SHA-256 of seed||u64(hash_index) covers 16 two-byte draws
+    (electra) or 32 one-byte draws; all digests for the window go through
+    the native short-message batch in one FFI call, with a hashlib loop
+    as fallback.  `i0` and `count` must be digest-aligned (multiples of
+    32), which `_SAMPLE_BATCH` guarantees.
+    """
+    from ..utils.native_hash import hash_short_batch
+    per = 16 if electra else 32
+    h0, h1 = i0 // per, (i0 + count) // per
+    msgs = np.empty((h1 - h0, 40), np.uint8)
+    msgs[:, :32] = np.frombuffer(seed, np.uint8)
+    msgs[:, 32:] = np.arange(h0, h1, dtype="<u8").view(np.uint8) \
+        .reshape(h1 - h0, 8)
+    raw = hash_short_batch(msgs.tobytes(), 40)
+    if raw is None:
+        raw = b"".join(
+            hashlib.sha256(seed + h.to_bytes(8, "little")).digest()
+            for h in range(h0, h1))
+    if electra:
+        return np.frombuffer(raw, dtype="<u2").astype(np.int64)
+    return np.frombuffer(raw, dtype=np.uint8).astype(np.int64)
+
+
 def compute_proposer_index(state: BeaconState, indices: np.ndarray,
                            seed: bytes) -> int:
+    """First shuffled candidate accepted by effective-balance rejection
+    sampling — the scalar spec loop evaluated a batch at a time (the
+    acceptance order is preserved, so the result is bit-identical)."""
     if len(indices) == 0:
         raise StateError("no active validators")
     p = state.T.preset
@@ -195,23 +294,17 @@ def compute_proposer_index(state: BeaconState, indices: np.ndarray,
     electra = state.fork_name >= ForkName.ELECTRA
     max_eb = (p.max_effective_balance_electra if electra
               else p.max_effective_balance)
-    i = 0
+    scale = 65535 if electra else 255
+    offsets = np.arange(_SAMPLE_BATCH)
+    i0 = 0
     while True:
-        candidate = int(indices[sigma[i % n]])
-        if electra:
-            rand = hashlib.sha256(
-                seed + (i // 16).to_bytes(8, "little")).digest()
-            off = (i % 16) * 2
-            r = int.from_bytes(rand[off:off + 2], "little")
-            if int(eb[candidate]) * 65535 >= max_eb * r:
-                return candidate
-        else:
-            rand = hashlib.sha256(
-                seed + (i // 32).to_bytes(8, "little")).digest()
-            r = rand[i % 32]
-            if int(eb[candidate]) * 255 >= max_eb * r:
-                return candidate
-        i += 1
+        candidates = indices[sigma[(i0 + offsets) % n]]
+        r = _candidate_randomness(seed, i0, _SAMPLE_BATCH, electra)
+        ok = np.flatnonzero(
+            eb[candidates].astype(np.int64) * scale >= max_eb * r)
+        if ok.size:
+            return int(candidates[ok[0]])
+        i0 += _SAMPLE_BATCH
 
 
 def get_beacon_proposer_index(state: BeaconState, slot: int | None = None
@@ -529,24 +622,17 @@ def get_next_sync_committee_indices(state: BeaconState) -> list[int]:
     electra = state.fork_name >= ForkName.ELECTRA
     max_eb = (p.max_effective_balance_electra if electra
               else p.max_effective_balance)
+    scale = 65535 if electra else 255
+    offsets = np.arange(_SAMPLE_BATCH)
     out: list[int] = []
-    i = 0
+    i0 = 0
     while len(out) < p.sync_committee_size:
-        candidate = int(indices[sigma[i % n]])
-        if electra:
-            rand = hashlib.sha256(
-                seed + (i // 16).to_bytes(8, "little")).digest()
-            off = (i % 16) * 2
-            r = int.from_bytes(rand[off:off + 2], "little")
-            ok = int(eb[candidate]) * 65535 >= max_eb * r
-        else:
-            rand = hashlib.sha256(
-                seed + (i // 32).to_bytes(8, "little")).digest()
-            ok = int(eb[candidate]) * 255 >= max_eb * rand[i % 32]
-        if ok:
-            out.append(candidate)
-        i += 1
-    return out
+        candidates = indices[sigma[(i0 + offsets) % n]]
+        r = _candidate_randomness(seed, i0, _SAMPLE_BATCH, electra)
+        ok = eb[candidates].astype(np.int64) * scale >= max_eb * r
+        out.extend(int(c) for c in candidates[ok])
+        i0 += _SAMPLE_BATCH
+    return out[:p.sync_committee_size]
 
 
 def get_next_sync_committee(state: BeaconState):

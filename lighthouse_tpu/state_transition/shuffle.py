@@ -29,6 +29,31 @@ def _round_source_bits(seed: bytes, r: int, n: int) -> np.ndarray:
     return np.unpackbits(byts, bitorder="little")
 
 
+def _all_round_source_digests(seed: bytes, rounds: int,
+                              n: int) -> np.ndarray | None:
+    """Every round's source digests in ONE native batch call:
+    (rounds, num_blocks*32) uint8, or None without the native hasher.
+
+    At 1M validators this is rounds*ceil(n/256) = ~352k independent
+    37-byte hashes — the dominant scalar cost of the shuffle before this
+    batching (shuffle_list.rs leans on the same per-round block layout).
+    """
+    from ..utils.native_hash import hash_short_batch
+    num_blocks = (n + 255) // 256
+    if rounds * num_blocks < 512:       # FFI wins only in bulk
+        return None
+    # message layout: seed(32) | round(1) | block_u32le(4)
+    buf = np.empty((rounds, num_blocks, 37), np.uint8)
+    buf[:, :, :32] = np.frombuffer(seed, np.uint8)
+    buf[:, :, 32] = np.arange(rounds, dtype=np.uint8)[:, None]
+    buf[:, :, 33:] = np.arange(num_blocks, dtype="<u4") \
+        .view(np.uint8).reshape(num_blocks, 4)[None, :, :]
+    out = hash_short_batch(buf.tobytes(), 37)
+    if out is None:
+        return None
+    return np.frombuffer(out, np.uint8).reshape(rounds, num_blocks * 32)
+
+
 def compute_shuffled_indices(n: int, seed: bytes,
                              rounds: int) -> np.ndarray:
     """Vector of sigma(i) for i in 0..n: position -> source index.
@@ -39,12 +64,16 @@ def compute_shuffled_indices(n: int, seed: bytes,
     if n == 0:
         return np.zeros(0, dtype=np.int64)
     idx = np.arange(n, dtype=np.int64)
+    digests = _all_round_source_digests(seed, rounds, n)
     # the scalar spec transform, applied to every index at once, round by round
     for r in range(rounds):
         pivot = _round_pivot(seed, r, n)
         flip = (pivot - idx) % n
         pos = np.maximum(idx, flip)
-        bits = _round_source_bits(seed, r, n)
+        if digests is not None:
+            bits = np.unpackbits(digests[r], bitorder="little")
+        else:
+            bits = _round_source_bits(seed, r, n)
         idx = np.where(bits[pos] == 1, flip, idx)
     return idx
 

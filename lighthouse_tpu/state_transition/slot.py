@@ -39,7 +39,10 @@ def per_slot_processing(state: BeaconState,
     boundaries)."""
     process_slot(state, state_root)
     if (state.slot + 1) % state.slots_per_epoch == 0:
-        per_epoch_processing(state)
+        from ..obs import tracing
+        with tracing.span("stf_epoch", epoch=int(state.current_epoch()),
+                          n_validators=len(state.validators)):
+            per_epoch_processing(state)
     state.slot += 1
     _maybe_upgrade_fork(state)
 

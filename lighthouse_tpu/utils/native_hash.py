@@ -23,8 +23,13 @@ def get_lib():
     _checked = True
     root = Path(__file__).resolve().parents[2]
     so = root / "native" / "libsha256host.so"
+    cpp = root / "native" / "sha256_host.cpp"
     try:
-        if not so.exists():
+        # rebuild when missing OR stale (the source has grown entry points
+        # since the .so was compiled; dlopen caches by path, so this must
+        # happen before the first CDLL of the process)
+        if not so.exists() or (cpp.exists()
+                               and so.stat().st_mtime < cpp.stat().st_mtime):
             subprocess.run(["sh", str(root / "native" / "build.sh")],
                            check=True, capture_output=True)
         lib = ctypes.CDLL(str(so))
@@ -44,6 +49,12 @@ def get_lib():
                 ctypes.c_uint32]
         except AttributeError:
             pass
+        try:   # short-message batch (absent in a stale .so)
+            lib.sha256_short_batch.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+                ctypes.c_uint64]
+        except AttributeError:
+            pass
         _lib = lib
     except Exception:
         _lib = None
@@ -61,6 +72,19 @@ def hash64_batch(data: bytes) -> bytes:
     n = len(data) // 64
     out = ctypes.create_string_buffer(n * 32)
     lib.sha256_hash64_batch(data, out, n)
+    return out.raw
+
+
+def hash_short_batch(data: bytes, msg_len: int) -> bytes | None:
+    """n independent msg_len-byte messages (msg_len <= 55, one padded
+    block each) -> n*32 digests; None when the library or the symbol is
+    unavailable (callers keep a hashlib loop as the fallback)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "sha256_short_batch") or msg_len > 55:
+        return None
+    n = len(data) // msg_len
+    out = ctypes.create_string_buffer(n * 32)
+    lib.sha256_short_batch(data, msg_len, out, n)
     return out.raw
 
 

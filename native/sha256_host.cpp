@@ -243,6 +243,27 @@ void sha256_merkle_root_mt(const uint8_t* leaves, uint64_t n_leaves,
   memcpy(root_out, cur, 32);
 }
 
+// n independent short messages (msg_len <= 55, so one padded block each)
+// -> n 32-byte digests.  Covers the rejection-sampling randomness
+// (seed||u64, 40B) and the shuffle round source bytes (seed||round||u32,
+// 37B) without the oneshot tail machinery per message.
+void sha256_short_batch(const uint8_t* in, uint64_t msg_len, uint8_t* out,
+                        uint64_t n) {
+  if (msg_len > 55) return;  // would need a second block; caller guards
+  uint8_t block[64];
+  for (uint64_t i = 0; i < n; i++) {
+    memset(block, 0, sizeof(block));
+    memcpy(block, in + msg_len * i, msg_len);
+    block[msg_len] = 0x80;
+    uint64_t bits = msg_len * 8;
+    for (int j = 7; j >= 0; j--) { block[56 + j] = bits & 0xFF; bits >>= 8; }
+    uint32_t st[8];
+    memcpy(st, IV, sizeof(st));
+    g_compress(st, block);
+    for (int j = 0; j < 8; j++) put_be32(out + 32 * i + 4 * j, st[j]);
+  }
+}
+
 // general sha256
 void sha256_oneshot(const uint8_t* data, uint64_t len, uint8_t* out) {
   uint32_t st[8];

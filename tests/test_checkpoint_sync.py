@@ -8,6 +8,10 @@ import time
 
 import pytest
 
+# Range sync / backfill here runs over real loopback sockets with a REAL
+# noise XX handshake; the stubbed primitives raise without cryptography.
+pytest.importorskip("cryptography")
+
 from lighthouse_tpu.chain import BeaconChainBuilder, BeaconChainHarness
 from lighthouse_tpu.containers.state import BeaconState
 from lighthouse_tpu.crypto import bls

@@ -158,6 +158,15 @@ def test_node_api_serves_real_identity_and_peers():
         assert rec.node_id == d1.disc.local_enr.node_id
         assert ident["p2p_addresses"] == \
             [f"/ip4/127.0.0.1/tcp/{s1.port}"]
+        # metadata bitfields reflect the live subscriptions: attnets from
+        # the node-id-derived attestation subnets, syncnets from the four
+        # sync-committee subnets (1-byte LE bitfield, metadata v2)
+        attnets = 0
+        for subnet in s1.attnet_subnets:
+            attnets |= 1 << subnet
+        assert ident["metadata"]["attnets"] == \
+            "0x" + attnets.to_bytes(8, "little").hex()
+        assert ident["metadata"]["syncnets"] == "0x0f"
         peers = api1.node_peers()
         assert len(peers) == 1
         assert peers[0]["direction"] == "outbound"

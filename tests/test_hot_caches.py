@@ -336,3 +336,59 @@ def test_eth1_finalization_cache_empty_boundary_primed(harness):
     snap = h.chain.eth1_finalization_cache.finalize(1, last_root)
     assert snap is not None
     assert snap["deposit_index"] == 64
+
+
+def test_attestation_data_rejects_out_of_range_committee(harness):
+    """Satellite gate: a committee_index past the epoch's
+    committees-per-slot must 400 on EVERY serving path (early cache,
+    attester cache, state fallback) instead of silently returning data
+    no committee can sign (attester_cache.rs CommitteeLengths)."""
+    from lighthouse_tpu.api.backend import ApiBackend, ApiError
+    from lighthouse_tpu.state_transition.helpers import (
+        get_committee_count_per_slot,
+    )
+    h = harness
+    h.extend_chain(3, attest=False)
+    api = ApiBackend(h.chain)
+    st = h.chain.head().head_state
+    cps = get_committee_count_per_slot(st, st.current_epoch())
+    slot = h.chain.slot()
+    # valid index works on the (primed) early-cache path
+    assert api.attestation_data(slot, cps - 1) is not None
+    for path in ("early", "attester", "state"):
+        if path == "attester":
+            h.chain.early_attester_cache._entry = None
+            h.chain.attester_cache.cache_state(h.chain, st)
+        elif path == "state":
+            h.chain.early_attester_cache._entry = None
+            h.chain.attester_cache._map.clear()
+        with pytest.raises(ApiError) as ei:
+            api.attestation_data(slot, cps)
+        assert ei.value.status == 400, path
+        # valid indices still serve after the rejection
+        assert api.attestation_data(slot, 0) is not None, path
+
+
+def test_shared_shuffling_cache_dedupes_across_states(harness):
+    """Tentpole: two distinct state objects on the same chain share one
+    committee layout via the (seed, epoch)-keyed shuffling cache — the
+    second state's committee_cache() is a shared hit, not a reshuffle."""
+    from lighthouse_tpu.state_transition.helpers import (
+        committee_cache, shared_shufflings,
+    )
+    h = harness
+    h.extend_chain(2, attest=False)
+    st = h.chain.head().head_state
+    epoch = st.current_epoch()
+    shared_shufflings.clear()
+    c1 = committee_cache(st, epoch)
+    misses = shared_shufflings.misses
+    other = st.copy()
+    c2 = committee_cache(other, epoch)
+    assert shared_shufflings.hits >= 1
+    assert shared_shufflings.misses == misses
+    assert c2 is c1                     # the layout object itself is shared
+    # per-state front line: repeated calls don't touch the shared cache
+    hits = shared_shufflings.hits
+    assert committee_cache(other, epoch) is c1
+    assert shared_shufflings.hits == hits

@@ -8,6 +8,10 @@ import time
 
 import pytest
 
+# The loopback transport performs a REAL noise XX handshake; without the
+# cryptography package the stubbed primitives raise at connect time.
+pytest.importorskip("cryptography")
+
 from lighthouse_tpu.chain import BeaconChainHarness
 from lighthouse_tpu.crypto import bls
 from lighthouse_tpu.network import NetworkConfig, NetworkService

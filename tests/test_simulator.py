@@ -1,4 +1,6 @@
 """Multi-node simulator: the whole-client tier (basic_sim.rs equivalent)."""
+import importlib.util
+
 import pytest
 
 from lighthouse_tpu.specs import minimal_spec
@@ -43,6 +45,8 @@ def test_vc_failover_between_nodes():
     assert nodes.nodes[0] is good
 
 
+@pytest.mark.skipif(importlib.util.find_spec("cryptography") is None,
+                    reason="LocalNetwork dials real noise-XX sockets")
 def test_two_node_network_finalizes():
     spec = minimal_spec(altair_fork_epoch=0)
     net = LocalNetwork(spec, node_count=2, validator_count=64)
@@ -55,6 +59,8 @@ def test_two_node_network_finalizes():
     assert not failures, failures
 
 
+@pytest.mark.skipif(importlib.util.find_spec("cryptography") is None,
+                    reason="LocalNetwork dials real noise-XX sockets")
 def test_http_sim_with_node_death_fails_over():
     """fallback_sim.rs equivalent: VCs drive their nodes over REAL HTTP
     (publication takes POST /eth/v1/beacon/blocks, not an in-process
