@@ -5,6 +5,19 @@ must run in seconds on a CPU-only container and inside tier-1 without
 touching jax. Rules register themselves via the :func:`rule` decorator at
 import time (``analysis/rules/__init__.py`` imports each rule module).
 
+v2 — the interprocedural engine. Analysis runs in two stages:
+
+1. **per-file** (parallelizable across worker processes, cached by file
+   content hash — ``cache.py``): parse, build the module's
+   :class:`~.callgraph.ModuleFacts`, run every rule's ``check_module``
+   and ``summarize_module``. The stage's output is picklable, so a file
+   that didn't change never re-parses.
+2. **cross-file**: build one shared :class:`~.callgraph.CallGraph` from
+   the facts and run each rule's ``finalize_project`` — trace-safety's
+   jit-root reachability, lock-order's acquisition-graph cycles,
+   shutdown-order's guard analysis and compile-budget's shape-key
+   enumeration all consume the same graph.
+
 Baseline discipline: ``baseline.json`` is a *reviewed* allowlist. Every
 entry must carry a non-empty ``justification`` and match at least one
 live violation — stale entries are reported so the allowlist cannot rot
@@ -18,6 +31,9 @@ import dataclasses
 import json
 import time
 from pathlib import Path
+
+from . import cache as cache_mod
+from .callgraph import CallGraph, ModuleFacts, build_facts  # noqa: F401
 
 #: directories never scanned (generated corpora, caches)
 _SKIP_PARTS = {"__pycache__", ".jax_cache", ".git"}
@@ -93,16 +109,51 @@ class Project:
         return cls(root, modules)
 
 
+@dataclasses.dataclass
+class AnalysisContext:
+    """What the cross-file stage hands each rule: the shared call graph,
+    per-module facts, and whatever each rule's ``summarize_module``
+    stored (all cache-safe plain data — never ASTs)."""
+    project: Project
+    facts: dict                 # relpath -> ModuleFacts
+    rule_data: dict             # relpath -> {rule_name: data}
+    graph: CallGraph
+
+    def data_for(self, rule_name: str) -> dict:
+        """relpath -> summary for one rule (modules that returned None
+        are omitted)."""
+        out = {}
+        for rel, per_rule in self.rule_data.items():
+            data = per_rule.get(rule_name)
+            if data is not None:
+                out[rel] = data
+        return out
+
+
 class Rule:
-    """Base rule. Subclasses set ``name``/``description`` and override
-    :meth:`check_module` (per file) and/or :meth:`finalize` (cross-file,
-    called once after every module was seen)."""
+    """Base rule. Subclasses set ``name``/``description`` and override:
+
+    - :meth:`check_module` — per file, runs in the (cached, parallel)
+      per-file stage; must not look at other modules.
+    - :meth:`summarize_module` — per file, same stage; returns plain
+      picklable data for the cross-file stage (or None).
+    - :meth:`finalize_project` — cross-file, runs once with the shared
+      :class:`AnalysisContext` (call graph + all summaries).
+    - :meth:`finalize` — legacy cross-file hook taking the raw Project;
+      prefer ``finalize_project`` (facts are cached, ASTs are not).
+    """
 
     name: str = ""
     description: str = ""
 
     def check_module(self, module: Module,
                      project: Project) -> list[Violation]:
+        return []
+
+    def summarize_module(self, module: Module, project: Project):
+        return None
+
+    def finalize_project(self, ctx: AnalysisContext) -> list[Violation]:
         return []
 
     def finalize(self, project: Project) -> list[Violation]:
@@ -153,19 +204,102 @@ def _baseline_matches(entry: dict, v: Violation) -> bool:
 
 # -- driver ------------------------------------------------------------------
 
+def _analyze_module(root: Path, mod: Module) -> dict:
+    """The per-file stage for one module: facts + every registered
+    rule's check_module/summarize_module. Output is picklable (cached
+    by content hash, shipped across worker processes)."""
+    from . import rules as _  # noqa: F401  (registry, in workers too)
+    mini = Project.__new__(Project)
+    mini.root = root
+    mini.modules = [mod]
+    payload = {"facts": build_facts(mod.tree, mod.relpath),
+               "violations": {}, "rule_data": {}}
+    for name, r in all_rules().items():
+        vs = r.check_module(mod, mini)
+        if vs:
+            payload["violations"][name] = \
+                [dataclasses.asdict(v) for v in vs]
+        data = r.summarize_module(mod, mini)
+        if data is not None:
+            payload["rule_data"][name] = data
+    return payload
+
+
+def _analyze_file(args: tuple) -> tuple:
+    """Worker-process entry point: (relpath, payload)."""
+    root_str, path_str, relpath, source = args
+    mod = Module(Path(path_str), relpath, source)
+    return relpath, _analyze_module(Path(root_str), mod)
+
+
 def run_project(project: Project, rules: dict[str, Rule] | None = None,
-                baseline: list[dict] | None = None) -> dict:
+                baseline: list[dict] | None = None, *,
+                jobs: int | None = None,
+                cache_path: Path | None = None) -> dict:
     """Run rules over the project. Returns a report dict:
     ``violations`` (non-baselined), ``baselined``, ``stale_baseline``
-    (entries that matched nothing), ``elapsed_s``."""
+    (entries that matched nothing), ``elapsed_s``, ``cached_files``.
+
+    ``jobs``: worker processes for the per-file stage (None/1 = in
+    process). ``cache_path``: persistent per-file cache (see cache.py).
+    The per-file stage always runs ALL registered rules so cached
+    entries are valid for any later ``--rules`` selection; ``rules``
+    filters reporting and the cross-file stage.
+    """
     rules = rules if rules is not None else all_rules()
     baseline = baseline or []
     t0 = time.monotonic()
+
+    cache = None
+    if cache_path is not None:
+        cache = cache_mod.FileCache(
+            cache_path, cache_mod.compute_salt(project.root))
+    results: dict[str, dict] = {}
+    keys: dict[str, str] = {}
+    misses: list[Module] = []
+    for mod in project.modules:
+        key = cache_mod.content_key(mod.source)
+        keys[mod.relpath] = key
+        hit = cache.get(key) if cache is not None else None
+        if hit is not None:
+            results[mod.relpath] = hit
+        else:
+            misses.append(mod)
+    cached_files = len(results)
+
+    if misses:
+        if jobs and jobs > 1 and len(misses) > 4:
+            from concurrent.futures import ProcessPoolExecutor
+            args = [(str(project.root), str(m.path), m.relpath, m.source)
+                    for m in misses]
+            with ProcessPoolExecutor(max_workers=jobs) as ex:
+                for relpath, payload in ex.map(_analyze_file, args,
+                                               chunksize=8):
+                    results[relpath] = payload
+        else:
+            for m in misses:
+                results[m.relpath] = _analyze_module(project.root, m)
+        if cache is not None:
+            for m in misses:
+                cache.put(keys[m.relpath], results[m.relpath])
+            cache.save()
+
     found: list[Violation] = []
+    for rel in results:
+        per_rule = results[rel]["violations"]
+        for rname in rules:
+            for v in per_rule.get(rname, ()):
+                found.append(Violation(**v))
+
+    ctx = AnalysisContext(
+        project=project,
+        facts={rel: p["facts"] for rel, p in results.items()},
+        rule_data={rel: p["rule_data"] for rel, p in results.items()},
+        graph=CallGraph({rel: p["facts"] for rel, p in results.items()}))
     for r in rules.values():
-        for mod in project.modules:
-            found.extend(r.check_module(mod, project))
+        found.extend(r.finalize_project(ctx))
         found.extend(r.finalize(project))
+
     live, waived = [], []
     used = [False] * len(baseline)
     for v in found:
@@ -183,6 +317,7 @@ def run_project(project: Project, rules: dict[str, Rule] | None = None,
         "stale_baseline": [e for i, e in enumerate(baseline) if not used[i]],
         "rules": sorted(rules),
         "files": len(project.modules),
+        "cached_files": cached_files,
         "elapsed_s": round(time.monotonic() - t0, 3),
     }
 
@@ -213,6 +348,49 @@ def render_json(report: dict) -> str:
         "rules": report["rules"],
         "files": report["files"],
         "elapsed_s": report["elapsed_s"],
+    }, indent=2)
+
+
+def render_sarif(report: dict, descriptions: dict | None = None) -> str:
+    """SARIF 2.1.0 for CI annotation / editor ingestion. Live findings
+    are ``error`` results; baselined ones carry an external suppression
+    so viewers show them struck-through instead of hiding the waiver."""
+    descriptions = descriptions or {}
+
+    def result(v: Violation, suppressed: bool) -> dict:
+        r = {
+            "ruleId": v.rule,
+            "level": "error",
+            "message": {"text": v.message +
+                        (f" [{v.symbol}]" if v.symbol else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": v.path},
+                    "region": {"startLine": max(v.line, 1)},
+                },
+            }],
+        }
+        if suppressed:
+            r["suppressions"] = [{"kind": "external"}]
+        return r
+
+    return json.dumps({
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "ANALYSIS.md",
+                "rules": [{"id": name,
+                           "shortDescription":
+                               {"text": descriptions.get(name, name)}}
+                          for name in report["rules"]],
+            }},
+            "results":
+                [result(v, False) for v in report["violations"]] +
+                [result(v, True) for v in report["baselined"]],
+        }],
     }, indent=2)
 
 

@@ -1,8 +1,11 @@
 """Rule modules register themselves with the engine on import."""
 from . import (  # noqa: F401
+    compile_budget,
     device_transfer,
     lock_discipline,
+    lock_order,
     recompilation,
+    shutdown_order,
     spec_constants,
     ssz_schema,
     thread_lifecycle,

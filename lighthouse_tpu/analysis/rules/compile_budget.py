@@ -1,0 +1,208 @@
+"""compile-budget: static twin of graftscope's ``jax_compile_total``.
+
+The §3 TPU flagship paths (``parallel/bls.py``, ``parallel/merkle.py``)
+run under a FIXED TWO-SHAPE compile budget: every jitted program is a
+memoized factory keyed by its static compile keys, and the whole
+pipeline may instantiate at most two shapes per program (the full
+``lanes`` batch and the sanctioned small-batch split). A third key — or
+a key derived from a raw input length — is how the round-2 twelve-minute
+compile and the per-call retrace regressions happened dynamically;
+this rule rejects them before they run.
+
+Mechanics (on the shared interprocedural engine):
+
+1. **programs** are enumerated from the shared per-file facts: memoized
+   (``@lru_cache``/``@cache``) factories whose bodies build a
+   ``jax.jit``/``shard_map`` program.
+2. every factory call site in the scoped modules is resolved through
+   the call graph; its argument expressions ARE the compile keys.
+3. **budget**: per program, the distinct key tuples across call sites
+   (compared as canonical source text) must number ≤ 2 — the 3rd+
+   distinct key is flagged at its call site, in line order.
+4. **shape-key provenance**: each key expression is expanded through
+   the enclosing function's assignments (textual fixpoint); a key whose
+   provenance contains a raw ``len(...)`` is flagged — array shapes
+   (``x.shape[...]``) are already compile keys, so shape-derived values
+   are sanctioned, but a raw input length makes the key track arbitrary
+   caller batch sizes (unbounded programs). Pad to the fixed lane count
+   (``host_prepare(..., lanes, small=...)``) before keying — pow-of-two
+   bucketing (``(len(x)-1).bit_length()``) is deliberately NOT
+   sanctioned: it bounds compiles logarithmically, not at two.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import Module, Project, Rule, Violation, dotted_name, rule
+
+_SCOPED = ("parallel/bls.py", "parallel/merkle.py", "compile_budget")
+_BUDGET = 2
+
+
+def _in_scope(rel: str) -> bool:
+    return any(rel.endswith(p) or p in rel for p in _SCOPED)
+
+
+class _FuncCollect(ast.NodeVisitor):
+    """Assignment provenance + call-argument texts for one function."""
+
+    def __init__(self):
+        self.assigns: dict[str, str] = {}    # var -> value source text
+        self.calls: list = []                # [name, line, [key texts]]
+
+    def _record_assign(self, targets, value) -> None:
+        try:
+            text = ast.unparse(value)
+        except Exception:
+            return
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    self.assigns[n.id] = text
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._record_assign(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_assign([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._record_assign([node.target], node.iter)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        if name:
+            try:
+                keys = [ast.unparse(a) for a in node.args] + \
+                       [f"{kw.arg}={ast.unparse(kw.value)}"
+                        for kw in node.keywords if kw.arg]
+                self.calls.append([name, node.lineno, keys])
+            except Exception:
+                pass
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return   # nested defs are collected under their own qualname
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _expand(text: str, assigns: dict, rounds: int = 4) -> str:
+    """Textual provenance fixpoint: substitute assigned variables by
+    their defining expressions (skipping self-referential defs)."""
+    for _ in range(rounds):
+        before = text
+        for var, val in assigns.items():
+            if re.search(rf"\b{re.escape(var)}\b", val):
+                continue             # x = x + 1: keep the symbol
+            text = re.sub(rf"\b{re.escape(var)}\b", f"({val})", text)
+            if len(text) > 10000:
+                return text
+        if text == before:
+            return text
+    return text
+
+
+@rule
+class CompileBudgetRule(Rule):
+    name = "compile-budget"
+    description = ("fixed two-shape compile budget on the parallel/ "
+                   "flagship paths: ≤2 distinct static keys per jit "
+                   "factory, no raw-length-derived keys")
+
+    # -- per-file (cached) stage ---------------------------------------------
+
+    def summarize_module(self, module: Module, project: Project):
+        if not _in_scope(module.relpath):
+            return None
+        funcs: dict[str, dict] = {}
+        stack: list[str] = []
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append(child.name)
+                    walk(child)
+                    stack.pop()
+                elif isinstance(child,
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    col = _FuncCollect()
+                    for stmt in child.body:
+                        col.visit(stmt)
+                    if col.calls:
+                        funcs[qual] = {"assigns": col.assigns,
+                                       "calls": col.calls}
+                    stack.append(child.name)
+                    walk(child)
+                    stack.pop()
+
+        walk(module.tree)
+        return {"funcs": funcs} if funcs else None
+
+    # -- cross-file stage -----------------------------------------------------
+
+    def finalize_project(self, ctx) -> list:
+        # 1. enumerate the jit programs from the shared facts
+        programs = set()
+        for rel, facts in ctx.facts.items():
+            if not _in_scope(rel):
+                continue
+            for qual, fn in facts.funcs.items():
+                if fn.is_memoized and fn.builds_jit:
+                    programs.add((rel, qual))
+        if not programs:
+            return []
+
+        # 2. resolve every scoped call site to a program
+        #    site: (program, key tuple, rel, line, caller qual)
+        sites = []
+        for rel, d in ctx.data_for(self.name).items():
+            for qual, f in d["funcs"].items():
+                for name, line, keys in f["calls"]:
+                    for cand in ctx.graph.resolve_call(rel, qual, name):
+                        if cand in programs:
+                            sites.append((cand, tuple(keys), rel, line,
+                                          qual, d["funcs"][qual]["assigns"]))
+                            break
+        sites.sort(key=lambda s: (s[2], s[3]))
+
+        out = []
+        # 3. the two-shape budget per program
+        seen_keys: dict[tuple, list] = {}
+        for prog, key, rel, line, qual, _assigns in sites:
+            keys = seen_keys.setdefault(prog, [])
+            if key in keys:
+                continue
+            keys.append(key)
+            if len(keys) > _BUDGET:
+                out.append(Violation(
+                    rule=self.name, path=rel, line=line,
+                    message=(f"distinct compile key #{len(keys)} for "
+                             f"'{prog[1]}' ({', '.join(key)}) exceeds "
+                             f"the fixed two-shape budget — reuse one "
+                             "of the two sanctioned shapes or fold this "
+                             "case into the small-batch split"),
+                    symbol=qual))
+
+        # 4. raw-length provenance on any key expression
+        for prog, key, rel, line, qual, assigns in sites:
+            for expr in key:
+                prov = _expand(expr, assigns)
+                if "len(" in prov and ".shape" not in prov:
+                    out.append(Violation(
+                        rule=self.name, path=rel, line=line,
+                        message=(f"compile key '{expr}' for "
+                                 f"'{prog[1]}' derives from a raw input "
+                                 "length (provenance: "
+                                 f"{prov[:120]}) — every distinct batch "
+                                 "size compiles a fresh program; pad to "
+                                 "the fixed lane count first"),
+                        symbol=qual))
+                    break
+        return out

@@ -9,20 +9,25 @@ recompile/round-trip class of regression the merkleization pipeline
 (``print``, mutating closure state, ``time.time()``) run once at trace
 time and then never again, so they are latent logic bugs.
 
-Mechanics:
+Mechanics (v2, on the shared interprocedural engine):
 1. jit roots: functions decorated with / passed to ``jax.jit``,
    ``jax.pmap`` or ``shard_map``.
-2. reachability: call edges between scanned functions (same module by
-   name, cross-module through ``from X import name``), BFS from roots.
+2. reachability: the shared :class:`~..callgraph.CallGraph` BFS from
+   roots (same module by name, cross-module through import resolution).
+   ``jax.pure_callback``/``jax.io_callback`` arguments are sanctioned
+   escape hatches — the callable they receive runs on the HOST, so the
+   graph records no edge into it and its body is never taint-checked.
 3. a per-function taint pass marks values derived from parameters as
    traced; ``.shape``/``.ndim``/``.dtype`` access launders taint (those
    are static Python values under tracing — the classic true negative).
+   The taint pass runs for *every* function in the cached per-file
+   stage; the cross-file stage keeps only the jit-reachable findings.
 """
 from __future__ import annotations
 
 import ast
 
-from ..engine import Module, Project, Rule, dotted_name, rule
+from ..engine import Module, Project, Rule, Violation, dotted_name, rule
 
 _JIT_WRAPPERS = {"jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
                  "jax.shard_map", "jax.experimental.shard_map.shard_map"}
@@ -122,52 +127,6 @@ class _FuncIndex(ast.NodeVisitor):
                     self._wrapped_names.add((".".join(self.stack),
                                              name.split(".")[-1]))
         self.generic_visit(node)
-
-
-#: higher-order callables whose *arguments* are traced as functions
-_HIGHER_ORDER = {"scan", "fori_loop", "while_loop", "cond", "switch",
-                 "map", "associative_scan", "vmap", "checkpoint", "remat",
-                 "custom_jvp", "custom_vjp", "partial"} | \
-    {n.split(".")[-1] for n in _JIT_WRAPPERS}
-
-
-def _called_names(fn: ast.FunctionDef) -> set[str]:
-    out = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            name = dotted_name(node.func)
-            if name:
-                out.add(name)
-            # callables passed into higher-order primitives only (scan
-            # bodies, cond branches) — a plain data argument must not
-            # become a call edge
-            if name.split(".")[-1] in _HIGHER_ORDER:
-                for arg in node.args:
-                    an = dotted_name(arg)
-                    if an:
-                        out.add(an)
-    return out
-
-
-def _imports(mod: Module) -> dict[str, tuple[str, str]]:
-    """local name -> (module dotted path, original name) for
-    ``from X import name`` statements."""
-    out = {}
-    for node in ast.walk(mod.tree):
-        if isinstance(node, ast.ImportFrom) and node.module is not None:
-            for alias in node.names:
-                out[alias.asname or alias.name] = (node.module, alias.name)
-    return out
-
-
-def _module_by_suffix(project: Project, dotted: str) -> Module | None:
-    """Resolve 'lighthouse_tpu.ops.bls12_381' / '..ops.bls12_381' to a
-    scanned module by path suffix (relative dots already stripped)."""
-    suffix = dotted.replace(".", "/") + ".py"
-    for m in project.modules:
-        if m.relpath.endswith(suffix):
-            return m
-    return None
 
 
 class _TaintChecker(ast.NodeVisitor):
@@ -305,66 +264,35 @@ class TraceSafetyRule(Rule):
     description = ("host syncs and Python side effects inside "
                    "jit/pmap/shard_map-reachable functions")
 
-    def finalize(self, project: Project) -> list:
-        indexes = {m.relpath: _FuncIndex(m) for m in project.modules}
-        imports = {m.relpath: _imports(m) for m in project.modules}
-        mods = {m.relpath: m for m in project.modules}
+    def summarize_module(self, module: Module, project: Project) -> dict:
+        """Cached per-file stage: jit roots + candidate findings for
+        EVERY function (keyed by qualname). Whether a function is
+        actually jit-reachable is a cross-file question answered in
+        :meth:`finalize_project`; computing candidates for all of them
+        keeps this stage independent of the rest of the tree."""
+        idx = _FuncIndex(module)
+        cands: dict[str, list] = {}
+        for qn, fn in idx.funcs.items():
+            checker = _TaintChecker(self.name, module, qn, fn)
+            if checker.violations:
+                cands[qn] = [v.to_json() for v in checker.violations]
+        return {"roots": sorted(idx.roots), "cands": cands}
 
-        # BFS over (module, qualname) from jit roots
-        work = [(rel, qn) for rel, idx in indexes.items()
-                for qn in idx.roots]
-        reachable = set(work)
-        while work:
-            rel, qn = work.pop()
-            fn = indexes[rel].funcs.get(qn)
-            if fn is None:
-                continue
-            for called in _called_names(fn):
-                if called.split(".")[-1] in _SANCTIONED_TRACE_CALLS:
-                    continue
-                base = called.split(".")[-1] if "." not in called \
-                    else None
-                cands: list[tuple[str, str]] = []
-                # same-module resolution (plain or Class.method names)
-                if "." not in called:
-                    cands += [(rel, q) for q in indexes[rel].funcs
-                              if q == called or q.endswith("." + called)]
-                    # cross-module via from-imports
-                    imp = imports[rel].get(called)
-                    if imp is not None:
-                        target = _module_by_suffix(project,
-                                                   imp[0].lstrip("."))
-                        if target is not None:
-                            tq = imp[1]
-                            if tq in indexes[target.relpath].funcs:
-                                cands.append((target.relpath, tq))
-                else:
-                    # module-attribute calls: bi.mont_mul, k.g1_scalar_mul
-                    prefix, attr = called.rsplit(".", 1)
-                    imp = imports[rel].get(prefix)
-                    mod_path = None
-                    if imp is not None:
-                        mod_path = (imp[0].lstrip(".") + "." + imp[1]) \
-                            .lstrip(".")
-                    else:
-                        mod_path = prefix
-                    target = _module_by_suffix(project, mod_path)
-                    if target is not None and \
-                            attr in indexes[target.relpath].funcs:
-                        cands.append((target.relpath, attr))
-                for cand in cands:
-                    if any(part in cand[0]
-                           for part in _SANCTIONED_MODULE_PARTS):
-                        continue     # obs internals are sanctioned
-                    if cand not in reachable:
-                        reachable.add(cand)
-                        work.append(cand)
-
+    def finalize_project(self, ctx) -> list:
+        data = ctx.data_for(self.name)
+        roots = [(rel, qn) for rel, d in data.items()
+                 for qn in d["roots"]]
+        reach = ctx.graph.reachable(
+            roots, self_calls=False,
+            skip_call=lambda name:
+                name.split(".")[-1] in _SANCTIONED_TRACE_CALLS,
+            skip_module=lambda rel:
+                any(part in rel for part in _SANCTIONED_MODULE_PARTS))
         out = []
-        for rel, qn in sorted(reachable):
-            fn = indexes[rel].funcs.get(qn)
-            if fn is None:
+        for rel, qn in sorted(reach):
+            d = data.get(rel)
+            if d is None:
                 continue
-            checker = _TaintChecker(self.name, mods[rel], qn, fn)
-            out.extend(checker.violations)
+            for v in d["cands"].get(qn, ()):
+                out.append(Violation(**v))
         return out

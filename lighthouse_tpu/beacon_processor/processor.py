@@ -96,6 +96,7 @@ class BeaconProcessor:
         workers so no processor thread outlives the chain/network it
         touches (clean-shutdown discipline, task_executor/src/lib.rs)."""
         self._stop = True
+        self.reprocess.close()
         self._event.set()
         if join:
             if self._manager.is_alive() and \

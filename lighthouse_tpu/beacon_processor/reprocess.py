@@ -26,6 +26,7 @@ class ReprocessQueue:
 
     def __init__(self, submit):
         self._submit = submit                 # BeaconProcessor.submit
+        self._closed = False
         self._by_slot: dict[int, list] = defaultdict(list)
         # root -> (parked_at_slot, [work, ...])
         self._by_root: dict[bytes, tuple[int, list]] = {}
@@ -41,6 +42,13 @@ class ReprocessQueue:
         self.replayed_total = 0
         self.expired_total = 0
         self.refused_total = 0
+
+    def close(self) -> None:
+        """Sever the injected submitter: called from the owning
+        BeaconProcessor's stop(), so a slot tick or late block import
+        racing the teardown drops its replays instead of landing them in
+        the stopped processor's queues."""
+        self._closed = True
 
     def park_until_slot(self, slot: int, work,
                         current_slot: int | None = None) -> None:
@@ -86,6 +94,8 @@ class ReprocessQueue:
                     self._by_root.pop(root)
                     self.expired_total += len(bucket)
                     self._by_root_count -= len(bucket)
+        if self._closed:
+            return 0                  # owner stopping: drop, don't submit
         for w in due:
             self._submit(w)
         if due:
@@ -99,6 +109,8 @@ class ReprocessQueue:
         with self._lock:
             _at, due = self._by_root.pop(block_root, (0, []))
             self._by_root_count -= len(due)
+        if self._closed:
+            return 0                  # owner stopping: drop, don't submit
         for w in due:
             self._submit(w)
         if due:

@@ -358,6 +358,8 @@ class Discv5:
         self.sock.sendto(pkt, addr)
 
     def _handle_message(self, msg: bytes, addr) -> None:
+        if not self._running:
+            return          # raced stop(): don't spawn past join_all
         t, body = wire.decode_message(msg)
         req_id = bytes(body[0])
         if t == wire.MSG_PING:

@@ -430,7 +430,7 @@ class NetworkService:
     def _deliver_gossip(self, topic: str, data: bytes, peer, ctx) -> None:
         """Route accepted gossip into the priority processor when present
         (network_beacon_processor role), else import inline."""
-        if ctx is None:
+        if ctx is None or self._stopping:
             return
         if self.processor is not None:
             from ..beacon_processor import Work, WorkType

@@ -123,6 +123,8 @@ class Peer:
     # -- inbound streams -------------------------------------------------------
 
     def _on_inbound_stream(self, stream: Stream) -> None:
+        if not self.alive:
+            return          # close() raced the mux callback
         self.transport._threads.spawn(self._serve_stream, stream,
                                       name="peer.serve_stream")
 
@@ -272,6 +274,9 @@ class Transport:
             return None
 
     def _register(self, peer: Peer) -> None:
+        if self._stop:
+            peer.close()    # accept/dial raced stop(): no thread may
+            return          # spawn after join_all has run
         self.peers[peer.node_id] = peer
         self._threads.spawn(self._read_loop, peer,
                             name="transport.read_loop")

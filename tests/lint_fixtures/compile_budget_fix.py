@@ -1,0 +1,58 @@
+"""Seeded fixture for the compile-budget rule.
+
+Every true-positive line carries a ``seeded`` marker; the two
+sanctioned shapes, key reuse, and shape-derived keys below must stay
+silent. This file is never imported, only AST-scanned (its name keeps
+it in the rule's scope).
+"""
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def _budget_fn(lanes, n_dev):
+    return jax.jit(lambda x: x * 2)
+
+
+@functools.lru_cache(maxsize=None)
+def _leak_fn(lanes):
+    return jax.jit(lambda x: x + 1)
+
+
+@functools.lru_cache(maxsize=None)
+def _pad_fn(lanes):
+    return jax.jit(lambda x: x)
+
+
+def full_batch(x, lanes, n_dev):
+    return _budget_fn(lanes, n_dev)(x)
+
+
+def small_batch(x, lanes, n_dev):
+    # the sanctioned second shape: the small-message split
+    return _budget_fn(lanes // 2, n_dev)(x)
+
+
+def third_shape(x, lanes, n_dev):
+    return _budget_fn(lanes // 4, n_dev)(x)  # seeded
+
+
+def repeat_full(x, lanes, n_dev):
+    # reuses an existing key: no new program compiles
+    return _budget_fn(lanes, n_dev)(x)
+
+
+def raw_length_key(xs):
+    return _leak_fn(len(xs))(xs)  # seeded
+
+
+def shape_key(x):
+    # array shapes already key compiles: shape-derived values add none
+    return _leak_fn(x.shape[0])(x)
+
+
+def pow2_bucketed(xs):
+    # log-bucketing bounds compiles logarithmically, not at two
+    lanes = 1 << (len(xs) - 1).bit_length()
+    return _pad_fn(lanes)(xs)  # seeded

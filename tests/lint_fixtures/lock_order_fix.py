@@ -1,0 +1,83 @@
+"""Seeded fixture for the lock-order rule.
+
+Every true-positive line carries a ``seeded`` marker; everything else
+— including the condvar/str.join true-negatives — must stay silent.
+This file is never imported, only AST-scanned.
+"""
+import os
+import threading
+import time
+
+
+class Inverted:
+    """Acquires its two locks in both orders — the classic deadlock."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._t = threading.Thread(target=lambda: None)
+
+    def forward(self):
+        with self._a:
+            with self._b:  # seeded
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:  # seeded
+                pass
+
+    def join_under_lock(self):
+        with self._a:
+            self._t.join()  # seeded
+
+    def sleep_under_lock(self):
+        with self._b:
+            time.sleep(0.1)  # seeded
+
+    def _drain(self):
+        # blocks, but holds nothing itself: only callers under a lock
+        # are flagged (at their call site)
+        self._t.join(1.0)
+
+    def indirect_block(self):
+        with self._a:
+            self._drain()  # seeded
+
+
+# -- true negatives ----------------------------------------------------------
+
+class Ordered:
+    """Consistent outer->inner order everywhere: no cycle."""
+
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+
+    def one(self):
+        with self._outer:
+            with self._inner:
+                return 1
+
+    def two(self):
+        with self._outer:
+            with self._inner:
+                return 2
+
+    def consumer(self):
+        # Condition.wait on the lock held at the site releases that
+        # lock while parked — the sanctioned producer/consumer shape
+        with self._cond:
+            self._cond.wait(timeout=1.0)
+
+    def renders(self):
+        with self._outer:
+            # str.join / os.path.join are not Thread.join
+            name = ",".join(["a", "b"])
+            return os.path.join("/tmp", name)
+
+    def unlocked_wait(self):
+        # blocking, but holding nothing: not a lock-order finding
+        self._stop.wait(timeout=0.5)

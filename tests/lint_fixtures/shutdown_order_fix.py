@@ -1,0 +1,80 @@
+"""Seeded fixture for the shutdown-order rule.
+
+Every true-positive line carries a ``seeded`` marker; the guarded /
+lifecycle-exempt shapes below must stay silent. This file is never
+imported, only AST-scanned (its name keeps it in the rule's scope).
+"""
+
+
+class Service:
+    """Stop path + guard flag; one handler forgets to check it."""
+
+    def __init__(self, pool):
+        self._pool = pool
+        self._stopping = False
+
+    def start(self):
+        # lifecycle-exempt: start() is ordered before any stop()
+        self._pool.submit(self._run)
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._stopping = True
+
+    def on_event(self, fn):
+        if self._stopping:
+            return
+        self._pool.submit(fn)        # guard checked above: fine
+
+    def on_gossip(self, fn):
+        self._pool.submit(fn)  # seeded
+
+    def pump(self, fn):
+        while not self._stopping:
+            self._pool.submit(fn)    # loop re-checks the guard: fine
+
+
+class Wrapper:
+    """Every submit funnels through a guarded same-class method."""
+
+    def __init__(self, pool):
+        self._pool = pool
+        self._closed = False
+
+    def close(self):
+        self._closed = True
+
+    def _submit(self, fn):
+        if self._closed:
+            return None
+        return self._pool.submit(fn)
+
+    def enqueue(self, fn):
+        return self._submit(fn)      # one hop into the guarded _submit
+
+
+class Queue:
+    """Injected submit callable, no stop/close: nothing can sever it."""
+
+    def __init__(self, submit):
+        self._submit = submit
+        self.items = []
+
+    def on_slot(self, w):
+        self._submit(w)  # seeded
+
+    def drain(self):
+        for w in self.items:
+            self._submit(w)  # seeded
+
+
+class Plain:
+    """No stop path, no injected callable: out of the bug class."""
+
+    def __init__(self, pool):
+        self._pool = pool
+
+    def kick(self, fn):
+        self._pool.submit(fn)

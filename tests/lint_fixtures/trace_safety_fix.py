@@ -89,3 +89,37 @@ def good_host_wrapper(x):
 @jax.jit
 def dispatch(x):
     return good_host_wrapper(x)
+
+
+# -- sanctioned host-callback escape hatches ---------------------------------
+
+def host_readout(v):
+    # runs on the HOST via pure_callback: may sync and print freely
+    print("host readout", v)
+    return np.asarray(v)
+
+
+def host_log(v):
+    time.time()
+    return v
+
+
+@jax.jit
+def good_callback_user(x):
+    # jax.pure_callback / jax.io_callback hand their callable to the
+    # HOST — the rule records the escape call but follows no edge into
+    # its arguments, so host_readout/host_log stay unreachable
+    y = jax.pure_callback(host_readout,
+                          jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    jax.io_callback(lambda v: host_log(v), None, x)
+    return y + 1
+
+
+@jax.jit
+def bad_callback_then_sync(x):
+    # the escape hatch sanctions the callback body, NOT what the trace
+    # does with its result afterwards
+    y = jax.pure_callback(host_readout,
+                          jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    n = y.item()  # seeded
+    return n

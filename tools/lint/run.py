@@ -3,16 +3,25 @@
 
     python tools/lint/run.py                       # text report, rc!=0 on findings
     python tools/lint/run.py --format json         # machine-readable
+    python tools/lint/run.py --format sarif        # CI / editor ingestion
     python tools/lint/run.py --rules trace-safety,lock-discipline path/
+    python tools/lint/run.py --changed HEAD~1      # report only files touched vs a ref
     python tools/lint/run.py --no-baseline         # raw findings
+    python tools/lint/run.py --jobs 4 --no-cache   # per-file stage tuning
 
 Exit codes: 0 clean (baselined findings allowed), 1 non-baselined
 violations, 2 usage/baseline-format errors. Pure AST — no jax import, so
-it runs in seconds on any CPU.
+it runs in seconds on any CPU; the content-hash cache makes warm reruns
+near-instant.
+
+``--changed REF`` still ANALYZES the full tree (the interprocedural
+rules need every module's facts to resolve calls — and the cache makes
+that cheap) but REPORTS only findings in files that differ from REF.
 """
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 
@@ -23,10 +32,33 @@ from lighthouse_tpu.analysis import (  # noqa: E402
     Project, all_rules, load_baseline, run_project,
 )
 from lighthouse_tpu.analysis.engine import (  # noqa: E402
-    render_json, render_text,
+    render_json, render_sarif, render_text,
 )
 
 DEFAULT_BASELINE = REPO / "lighthouse_tpu" / "analysis" / "baseline.json"
+DEFAULT_CACHE = REPO / ".graftlint.cache"
+
+
+def _changed_paths(ref: str) -> set[str] | None:
+    """Repo-relative paths that differ from ``ref`` (tracked diff +
+    untracked files), or None if git is unavailable."""
+    out: set[str] = set()
+    try:
+        for cmd in (["git", "diff", "--name-only", ref, "--"],
+                    ["git", "ls-files", "--others",
+                     "--exclude-standard"]):
+            proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                                  text=True, timeout=30)
+            if proc.returncode != 0:
+                print(f"--changed: {' '.join(cmd)} failed: "
+                      f"{proc.stderr.strip()}", file=sys.stderr)
+                return None
+            out.update(line.strip() for line in proc.stdout.splitlines()
+                       if line.strip())
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"--changed: git unavailable: {e}", file=sys.stderr)
+        return None
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -34,12 +66,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("paths", nargs="*", type=Path,
                     default=None, help="files/dirs to scan "
                     "(default: lighthouse_tpu/)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--rules", default="",
                     help="comma-separated rule names (default: all)")
     ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE)
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the allowlist, report everything")
+    ap.add_argument("--changed", metavar="REF", default=None,
+                    help="report only findings in files that differ "
+                    "from this git ref (full tree is still analyzed)")
+    ap.add_argument("--jobs", type=int, default=None,
+                    help="worker processes for the per-file stage "
+                    "(default: serial; the cache usually wins on reruns)")
+    ap.add_argument("--cache", type=Path, default=DEFAULT_CACHE,
+                    help=f"per-file analysis cache (default: "
+                    f"{DEFAULT_CACHE.name} at the repo root)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the content-hash cache")
     args = ap.parse_args(argv)
 
     rules = all_rules()
@@ -58,12 +102,44 @@ def main(argv: list[str] | None = None) -> int:
         print(f"baseline error: {e}", file=sys.stderr)
         return 2
 
+    changed: set[str] | None = None
+    if args.changed is not None:
+        git_paths = _changed_paths(args.changed)
+        if git_paths is None:
+            return 2
+        # violation paths are relative to the scan root's parent
+        changed = set()
+        for p in git_paths:
+            try:
+                changed.add(str((REPO / p).resolve()
+                                .relative_to(REPO.parent)))
+            except ValueError:
+                continue
+
     paths = args.paths or [REPO / "lighthouse_tpu"]
     project = Project.load(REPO, paths)
-    report = run_project(project, rules, baseline)
-    out = render_json(report) if args.format == "json" else \
-        render_text(report)
-    print(out)
+    report = run_project(
+        project, rules, baseline, jobs=args.jobs,
+        cache_path=None if args.no_cache else args.cache)
+    if changed is not None:
+        report["violations"] = [v for v in report["violations"]
+                                if v.path in changed]
+        report["baselined"] = [v for v in report["baselined"]
+                               if v.path in changed]
+        # a baseline entry for an untouched file is not stale just
+        # because this invocation filtered its file out
+        report["stale_baseline"] = []
+    if args.format == "json":
+        out = render_json(report)
+    elif args.format == "sarif":
+        out = render_sarif(report, {n: r.description
+                                    for n, r in all_rules().items()})
+    else:
+        out = render_text(report)
+    try:
+        print(out)
+    except BrokenPipeError:
+        pass                         # | head etc. closed the pipe
     return 1 if report["violations"] else 0
 
 
