@@ -10,7 +10,8 @@ path, or the submit lands in a torn-down executor
 worse, silently resurrects work mid-teardown.
 
 Scope: the concurrent service layer named by the audit surface —
-``beacon_processor/``, ``network/``, ``sync/``, ``execution_layer/``
+``beacon_processor/``, ``network/``, ``sync/``, ``execution_layer/``,
+``testing/`` (the simulator drives those services from its own threads)
 (plus this rule's fixture).
 
 A submit site passes when any of:
@@ -40,7 +41,7 @@ import re
 from ..engine import Module, Project, Rule, Violation, dotted_name, rule
 
 _SCOPED = ("beacon_processor/", "network/", "sync/", "execution_layer/",
-           "shutdown_order")
+           "testing/", "shutdown_order")
 #: method names that constitute the object's stop path
 _STOP_METHODS = re.compile(r"^(stop|shutdown|close|halt|teardown)")
 #: attribute names that read as lifecycle guard flags

@@ -54,6 +54,18 @@ def observe(name: str, value: float, help_: str = "") -> None:
     _get(Histogram, name, help_ or name).observe(value)
 
 
+def counter_value(name: str) -> float:
+    """Current value of a registered counter (0.0 when unregistered or
+    prometheus is absent).  Scenario assertions read counters through
+    this instead of scraping /metrics."""
+    if not _HAVE_PROM:
+        return 0.0
+    m = _metrics.get(name)
+    if m is None:
+        return 0.0
+    return float(m._value.get())
+
+
 class MetricsServer:
     """/metrics scrape endpoint (beacon_node/http_metrics)."""
 

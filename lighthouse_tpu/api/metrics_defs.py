@@ -88,6 +88,14 @@ CATALOG: dict[str, tuple[str, str]] = {
     "beacon_processor_queue_length": ("gauge", "Pending work items"),
     "beacon_processor_reprocess_total":
         ("counter", "Requeued early-arriving work"),
+    "beacon_processor_work_dropped_total":
+        ("counter", "Work items shed at queue capacity (oldest-first)"),
+    "beacon_batch_verify_fallback_total":
+        ("counter", "Batch signature verifications split into per-item "
+                    "retries after a failed multi-set check"),
+    "vc_http_retries_total":
+        ("counter", "Validator-client HTTP requests retried after a "
+                    "connection-level failure"),
     # -- op pool ---------------------------------------------------------
     "op_pool_attestations": ("gauge", "Attestations pooled"),
     "op_pool_slashings": ("gauge", "Slashings pooled"),

@@ -87,6 +87,7 @@ class BeaconProcessor:
                                          name="beacon_processor.manager")
         self.dropped = 0
         self.processed = 0
+        self.high_water = 0     # max total pending ever seen (scenarios)
 
     def start(self) -> None:
         self._manager.start()
@@ -110,13 +111,18 @@ class BeaconProcessor:
         with self._lock:
             q = self.queues[work.kind]
             cap = self.caps.get(work.kind, 4096)
-            if len(q) >= cap:
+            shed = len(q) >= cap
+            if shed:
                 # drop oldest (gossip) — lossy under overload by design
                 q.popleft()
                 self.dropped += 1
             q.append(work)
             pending = sum(len(qq) for qq in self.queues.values())
+            if pending > self.high_water:
+                self.high_water = pending
         from ..api import metrics_defs as M
+        if shed:
+            M.count("beacon_processor_work_dropped_total")
         M.count("beacon_processor_work_events_total")
         M.gauge("beacon_processor_queue_length", pending)
         self._event.set()

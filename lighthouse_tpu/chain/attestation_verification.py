@@ -161,12 +161,26 @@ def verify_unaggregated_checks(chain, attestation,
     validator = indexed.attesting_indices[0]
     if chain.observed_attesters.has_been_observed(
             attestation.data.target.epoch, validator):
+        # the gossip pipeline dedups per (epoch, validator) BEFORE the
+        # signature check, but a second distinct vote from the same
+        # validator is exactly what the slasher exists to see — verify
+        # its signature here (so the slasher only ever ingests
+        # authenticated messages) and feed it before rejecting
+        sl = getattr(chain, "slasher", None)
+        if sl is not None and bls.verify_signature_sets([s]):
+            sl.accept_attestation(indexed)
         raise AttestationError(PRIOR_SEEN, f"validator {validator}")
     return indexed, base, s
 
 
 def finalize_unaggregated(chain, attestation, indexed,
                           subnet_id) -> VerifiedUnaggregatedAttestation:
+    # every path into finalize has a verified signature (single, batch,
+    # or per-item fallback) — the slasher feed point for gossip
+    # attestations (slasher feed discipline: authenticated input only)
+    sl = getattr(chain, "slasher", None)
+    if sl is not None:
+        sl.accept_attestation(indexed)
     # re-check after signature verification so duplicates *within* one batch
     # are caught (attestation_verification.rs:968-971)
     already = chain.observed_attesters.observe(
@@ -216,6 +230,12 @@ def _batch_verify_unaggregated(chain, attestations: list) -> list:
             except AttestationError as e:
                 results[i] = e
     else:
+        # fallback splitting: the fused multi-set verification failed, so
+        # at least one signature is invalid — retry per item so the good
+        # attestations in the batch still land (batch.rs:133 behavior)
+        if sets:
+            from ..api import metrics_defs as M
+            M.count("beacon_batch_verify_fallback_total")
         for i, att, subnet, indexed, _state, s in prepared:
             try:
                 if bls.verify_signature_sets([s]):
@@ -284,6 +304,9 @@ def finalize_aggregated(chain, signed_aggregate,
                         indexed) -> VerifiedAggregatedAttestation:
     msg = signed_aggregate.message
     data = msg.aggregate.data
+    sl = getattr(chain, "slasher", None)
+    if sl is not None:
+        sl.accept_attestation(indexed)
     already = chain.observed_aggregators.observe(data.slot,
                                                  msg.aggregator_index)
     if already:
@@ -326,6 +349,9 @@ def _batch_verify_aggregated(chain, aggregates: list) -> list:
             except AttestationError as e:
                 results[i] = e
     else:
+        if all_sets:
+            from ..api import metrics_defs as M
+            M.count("beacon_batch_verify_fallback_total")
         for i, agg, indexed, sets in prepared:
             try:
                 if bls.verify_signature_sets(sets):

@@ -129,6 +129,11 @@ class BeaconChain:
         # set by the network service when a BeaconProcessor is attached;
         # drives the park-and-replay queue (work_reprocessing_queue.rs)
         self.processor = None
+        # optional Slasher: gossip verification feeds it authenticated
+        # block headers and indexed attestations when set (the client
+        # builder wires it behind slasher_enabled; scenarios attach one
+        # directly)
+        self.slasher = None
 
         self.observed_block_producers = ObservedBlockProducers()
         self.observed_attesters = ObservedAttesters()
@@ -466,11 +471,19 @@ class BeaconChain:
                         indexed_atts.append(indexed)
                         self.fork_choice.on_attestation(
                             current_slot, indexed, is_from_block=True)
-                    except Exception as e:  # best-effort, but loudly
+                    except Exception as e:  # best-effort
                         import logging
-                        logging.getLogger("lighthouse_tpu.chain").warning(
-                            "on-block attestation skipped in fork choice: "
-                            "%r", e)
+
+                        from ..fork_choice import ForkChoiceError
+                        # ForkChoiceError here is routine during fork-branch
+                        # imports (the block's attestations can reference
+                        # ancestors the store hasn't seen yet); anything
+                        # else is worth a warning.
+                        lvl = (logging.DEBUG if isinstance(e, ForkChoiceError)
+                               else logging.WARNING)
+                        logging.getLogger("lighthouse_tpu.chain").log(
+                            lvl, "on-block attestation skipped in fork "
+                            "choice: %r", e)
                 for slashing in block.body.attester_slashings:
                     self.fork_choice.on_attester_slashing(
                         slashing.attestation_1)

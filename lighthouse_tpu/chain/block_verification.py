@@ -88,6 +88,20 @@ def _verify_block_for_gossip(chain, signed_block, block,
     if seen == "slashable":
         chain.observed_slashable.observe(block.slot, block.proposer_index,
                                          block_root)
+        # the equivocating second proposal is rejected from gossip, but
+        # it is exactly what the slasher exists to see: authenticate it
+        # (slasher feed discipline — signed input only) and hand the
+        # header over before raising
+        sl = getattr(chain, "slasher", None)
+        if sl is not None:
+            try:
+                s = _proposer_signature_set(chain, signed_block, block,
+                                            block_root)
+                if bls.verify_signature_sets([s]):
+                    sl.accept_block_header(
+                        signed_header_of(chain.T, signed_block))
+            except IndexError:
+                pass
         raise BlockError(REPEAT_PROPOSAL,
                          f"proposer {block.proposer_index} equivocated")
 
@@ -113,22 +127,7 @@ def _verify_block_for_gossip(chain, signed_block, block,
     # proposer signature (beacon_chain.rs:2140): pubkey from the head
     # registry (append-only), domain from the spec fork schedule — no
     # state replay on this path either
-    head_state = chain.head().head_state
-    try:
-        from ..specs.chain_spec import compute_domain
-        from ..specs.constants import DOMAIN_BEACON_PROPOSER
-        version = chain.spec.fork_version(
-            chain.spec.fork_name_at_slot(block.slot))
-        domain = compute_domain(DOMAIN_BEACON_PROPOSER, version,
-                                head_state.genesis_validators_root)
-        from ..specs.chain_spec import compute_signing_root
-        signing_root = compute_signing_root(block_root, domain)
-        pk = head_state.validators.pubkey(block.proposer_index)
-        s = bls.SignatureSet(signed_block.signature, [pk], signing_root)
-    except IndexError:
-        state = chain.state_for_block_production(block.parent_root,
-                                                 block.slot)
-        s = block_proposal_signature_set(state, signed_block, block_root)
+    s = _proposer_signature_set(chain, signed_block, block, block_root)
     if not bls.verify_signature_sets([s]):
         raise BlockError(INVALID_SIGNATURE, "proposer signature")
 
@@ -136,7 +135,41 @@ def _verify_block_for_gossip(chain, signed_block, block,
                                            block_root)
     chain.observed_slashable.observe(block.slot, block.proposer_index,
                                      block_root)
+    sl = getattr(chain, "slasher", None)
+    if sl is not None:
+        sl.accept_block_header(signed_header_of(chain.T, signed_block))
     return GossipVerifiedBlock(signed_block, block_root)
+
+
+def _proposer_signature_set(chain, signed_block, block, block_root: bytes):
+    head_state = chain.head().head_state
+    try:
+        from ..specs.chain_spec import compute_domain, compute_signing_root
+        from ..specs.constants import DOMAIN_BEACON_PROPOSER
+        version = chain.spec.fork_version(
+            chain.spec.fork_name_at_slot(block.slot))
+        domain = compute_domain(DOMAIN_BEACON_PROPOSER, version,
+                                head_state.genesis_validators_root)
+        signing_root = compute_signing_root(block_root, domain)
+        pk = head_state.validators.pubkey(block.proposer_index)
+        return bls.SignatureSet(signed_block.signature, [pk], signing_root)
+    except IndexError:
+        state = chain.state_for_block_production(block.parent_root,
+                                                 block.slot)
+        return block_proposal_signature_set(state, signed_block, block_root)
+
+
+def signed_header_of(T, signed_block):
+    """SignedBeaconBlockHeader with the block's root-equivalent header
+    (SSZ guarantees htr(header) == htr(block), so the block signature
+    verifies against the header's signing root too)."""
+    block = signed_block.message
+    header = T.BeaconBlockHeader(
+        slot=block.slot, proposer_index=block.proposer_index,
+        parent_root=block.parent_root, state_root=block.state_root,
+        body_root=htr(block.body))
+    return T.SignedBeaconBlockHeader(message=header,
+                                     signature=signed_block.signature)
 
 
 def into_signature_verified(chain, signed_block, block_root: bytes,

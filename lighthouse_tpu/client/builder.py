@@ -17,7 +17,7 @@ from ..chain import BeaconChainBuilder
 from ..chain.execution import MockExecutionLayer
 from ..crypto import bls
 from ..network import NetworkConfig, NetworkService
-from ..slasher import Slasher, SlasherConfig
+from ..slasher import Slasher, SlasherConfig, record_to_operation
 from ..specs.chain_spec import ChainSpec
 from ..store import HotColdDB, MemoryStore, NativeKvStore
 from ..utils.slot_clock import SystemTimeSlotClock
@@ -175,6 +175,9 @@ class ClientBuilder:
         if cfg.slasher_enabled:
             client.slasher = Slasher(SlasherConfig(),
                                      store=client.chain.store.hot)
+            # gossip verification feeds the slasher authenticated
+            # headers/attestations through this back-pointer
+            client.chain.slasher = client.slasher
 
         # network, fed through the priority beacon processor
         from ..beacon_processor import BeaconProcessor
@@ -229,7 +232,15 @@ class ClientBuilder:
                         except Exception:
                             pass
                     if client.slasher is not None:
-                        client.slasher.process_queued(chain.epoch())
+                        found = client.slasher.process_queued(chain.epoch())
+                        for rec in found:
+                            op = record_to_operation(rec, chain.T)
+                            if op is None:
+                                continue
+                            if hasattr(op, "signed_header_1"):
+                                chain.op_pool.insert_proposer_slashing(op)
+                            else:
+                                chain.op_pool.insert_attester_slashing(op)
                     head = chain.head()
                     set_gauge("beacon_head_slot", head.head_state.slot)
                     set_gauge("beacon_finalized_epoch",

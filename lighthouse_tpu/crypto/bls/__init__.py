@@ -59,11 +59,26 @@ class BlsBackend:
         raise NotImplementedError
 
 
+#: first byte of a signature the Fake backend treats as INVALID.  A real
+#: compressed G2 point can never lead with 0xff (compression + infinity
+#: bits both set with a nonzero body), so adversarial tests can forge
+#: "cryptographically bad" signatures that still exercise the full
+#: verification pipeline: b"\xff" + 95 arbitrary bytes.
+POISON_SIGNATURE_BYTE = 0xFF
+
+
 class FakeBackend(BlsBackend):
     """Always-valid crypto for tests that exercise everything *but* crypto
-    (the reference runs most chain tests this way, impls/fake_crypto.rs)."""
+    (the reference runs most chain tests this way, impls/fake_crypto.rs).
+    One carve-out: signatures leading with POISON_SIGNATURE_BYTE fail, so
+    invalid-signature adversarial scenarios keep working without real
+    pairings."""
 
     name = "fake"
+
+    @staticmethod
+    def _poisoned(sig: bytes) -> bool:
+        return len(sig) > 0 and sig[0] == POISON_SIGNATURE_BYTE
 
     def sk_to_pk(self, sk: int) -> bytes:
         return bytes([0x80]) + (sk % 2**376).to_bytes(47, "big")
@@ -73,16 +88,17 @@ class FakeBackend(BlsBackend):
             + msg[:32].ljust(32, b"\0") + b"\x00" * 48
 
     def verify(self, pk, msg, sig) -> bool:
-        return True
+        return not self._poisoned(sig)
 
     def fast_aggregate_verify(self, pks, msg, sig) -> bool:
-        return bool(pks)
+        return bool(pks) and not self._poisoned(sig)
 
     def aggregate_verify(self, pks, msgs, sig) -> bool:
-        return bool(pks)
+        return bool(pks) and not self._poisoned(sig)
 
     def verify_signature_sets(self, sets) -> bool:
-        return all(s.pubkeys for s in sets)
+        return all(s.pubkeys and not self._poisoned(s.signature)
+                   for s in sets)
 
     def aggregate_signatures(self, sigs) -> bytes:
         return sigs[0] if sigs else INFINITY_SIGNATURE

@@ -1,0 +1,254 @@
+"""Fault-injecting network fabric: link control for adversarial scenarios.
+
+Three pieces (ISSUE 7 tentpole, ROADMAP item 4):
+
+``ScenarioClock``
+    A deterministic logical clock.  The scenario engine advances it
+    explicitly (one tick per duty phase); nothing in the fabric reads
+    wall time, so a run's fault schedule is a pure function of the seed
+    and the tick sequence.
+
+``FaultInjector``
+    The shared link-control plane.  Every node's ``FaultyTransport``
+    registers under a scenario-chosen label; per-directed-link
+    ``LinkPolicy`` entries then drop, delay (released on later ticks),
+    or reorder gossip RPC frames, and ``partition()`` cuts whole link
+    sets — existing cross-partition connections are closed and new
+    dials refused, which is how long partitions look on mainnet (TCP
+    sessions die; reconnection attempts fail).  ``heal()`` clears every
+    policy; re-dialing is the caller's job (LocalNetwork.heal) because
+    only it knows the intended topology.
+
+``FaultyTransport``
+    A Transport subclass wired to the injector: dials consult the cut
+    matrix, inbound upgrades of cut peers are refused post-handshake,
+    and each registered peer's ``send_gossip_rpc`` is wrapped with the
+    link policy.  Req/resp (sync, status) is intentionally NOT
+    per-frame-faulted: a cut link has no connection at all, and a live
+    link's RPC integrity is what yamux provides — dropping arbitrary
+    mux frames would corrupt the stream state machine rather than model
+    a real network fault.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+
+from .transport import Transport
+
+
+class ScenarioClock:
+    """Logical tick counter; advanced only by the scenario engine."""
+
+    def __init__(self, start: int = 0):
+        self.tick = start
+
+    def advance(self, n: int = 1) -> int:
+        self.tick += n
+        return self.tick
+
+
+@dataclass
+class LinkPolicy:
+    """Fault policy for one directed link (src label -> dst label)."""
+    cut: bool = False           # refuse dials, close connections
+    drop_rate: float = 0.0      # P(drop) per gossip RPC frame
+    delay_ticks: int = 0        # hold frames for N scenario ticks
+    reorder: bool = False       # shuffle frames released on the same tick
+
+    @property
+    def is_default(self) -> bool:
+        return (not self.cut and self.drop_rate == 0.0
+                and self.delay_ticks == 0 and not self.reorder)
+
+
+class FaultInjector:
+    """Seeded, deterministic link-control plane shared by every
+    FaultyTransport in one scenario."""
+
+    def __init__(self, seed: int = 0, clock: ScenarioClock | None = None):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.clock = clock or ScenarioClock()
+        self._lock = threading.Lock()
+        self._policies: dict[tuple[str, str], LinkPolicy] = {}
+        self._transports: dict[str, Transport] = {}
+        self._labels: dict[str, str] = {}       # node_id hex -> label
+        self._addrs: dict[tuple[str, int], str] = {}
+        #: [(release_tick, seq, link, send_fn, frame)]
+        self._delayed: list = []
+        self._seq = 0
+        # counters the scenarios assert on
+        self.frames_dropped = 0
+        self.frames_delayed = 0
+        self.frames_reordered = 0
+        self.dials_refused = 0
+        self.links_severed = 0
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, label: str, transport: Transport) -> None:
+        with self._lock:
+            self._transports[label] = transport
+            self._labels[transport.node_id] = label
+            self._addrs[(transport.host, transport.port)] = label
+
+    def label_of(self, node_id: str) -> str | None:
+        return self._labels.get(node_id)
+
+    def label_at(self, host: str, port: int) -> str | None:
+        return self._addrs.get((host, port))
+
+    # -- policy --------------------------------------------------------------
+
+    def policy(self, src: str | None, dst: str | None) -> LinkPolicy:
+        if src is None or dst is None:
+            return _DEFAULT
+        return self._policies.get((src, dst), _DEFAULT)
+
+    def set_link(self, src: str, dst: str, policy: LinkPolicy,
+                 symmetric: bool = True) -> None:
+        with self._lock:
+            self._policies[(src, dst)] = policy
+            if symmetric:
+                self._policies[(dst, src)] = policy
+        if policy.cut:
+            self._sever(src, dst)
+            if symmetric:
+                self._sever(dst, src)
+
+    def partition(self, *groups) -> None:
+        """Cut every link between nodes in different label groups."""
+        cut = LinkPolicy(cut=True)
+        for i, ga in enumerate(groups):
+            for gb in groups[i + 1:]:
+                for a in ga:
+                    for b in gb:
+                        self.set_link(a, b, cut, symmetric=True)
+
+    def heal(self) -> None:
+        """Clear every policy and flush held frames (they were faulted
+        while in flight; delivering them now models late arrival)."""
+        with self._lock:
+            self._policies.clear()
+            due, self._delayed = self._delayed, []
+        for _tick, _seq, _link, send_fn, frame in sorted(due,
+                                                         key=lambda d: d[1]):
+            try:
+                send_fn(frame)
+            except Exception:
+                pass    # peer may be gone; gossip is lossy by contract
+
+    def _note_refused(self) -> None:
+        with self._lock:
+            self.dials_refused += 1
+
+    def _sever(self, src: str, dst: str) -> None:
+        """Close existing connections crossing a newly-cut link."""
+        t = self._transports.get(src)
+        if t is None:
+            return
+        for peer in list(t.peers.values()):
+            if self._labels.get(peer.node_id) == dst:
+                with self._lock:
+                    self.links_severed += 1
+                peer.close()
+
+    # -- the gossip-frame data plane -----------------------------------------
+
+    def on_gossip_frame(self, src: str, dst: str | None, send_fn,
+                        frame: bytes) -> None:
+        pol = self.policy(src, dst)
+        if pol.is_default:
+            send_fn(frame)
+            return
+        with self._lock:
+            if pol.cut or (pol.drop_rate and
+                           self.rng.random() < pol.drop_rate):
+                self.frames_dropped += 1
+                return
+            if pol.delay_ticks > 0:
+                self._seq += 1
+                self.frames_delayed += 1
+                self._delayed.append((self.clock.tick + pol.delay_ticks,
+                                      self._seq, (src, dst), send_fn, frame))
+                return
+        send_fn(frame)
+
+    def tick(self, n: int = 1) -> int:
+        """Advance the scenario clock and release due delayed frames.
+        Release order is deterministic: by (due tick, submit order),
+        except frames on a reordering link, which are shuffled with the
+        seeded RNG within their release batch."""
+        released = 0
+        for _ in range(n):
+            now = self.clock.advance()
+            with self._lock:
+                due = [d for d in self._delayed if d[0] <= now]
+                self._delayed = [d for d in self._delayed if d[0] > now]
+                due.sort(key=lambda d: (d[0], d[1]))
+                by_link: dict[tuple, list] = {}
+                for d in due:
+                    by_link.setdefault(d[2], []).append(d)
+                batches = []
+                for link, items in sorted(by_link.items()):
+                    if self.policy(*link).reorder and len(items) > 1:
+                        self.rng.shuffle(items)
+                        self.frames_reordered += len(items)
+                    batches.extend(items)
+            for _tick, _seq, _link, send_fn, frame in batches:
+                released += 1
+                try:
+                    send_fn(frame)
+                except Exception:
+                    pass
+        return released
+
+    # -- dial/accept control (used by FaultyTransport) -----------------------
+
+    def refuse_dial(self, src: str, host: str, port: int) -> bool:
+        dst = self.label_at(host, port)
+        if dst is not None and self.policy(src, dst).cut:
+            self._note_refused()
+            return True
+        return False
+
+    def refuse_peer(self, src: str, node_id: str) -> bool:
+        dst = self.label_of(node_id)
+        return dst is not None and self.policy(src, dst).cut
+
+
+_DEFAULT = LinkPolicy()
+
+
+class FaultyTransport(Transport):
+    """Transport with every fault choke point routed through a
+    FaultInjector.  Constructed exactly like Transport plus
+    (injector, label); registers itself on construction so the
+    injector's address/label maps are complete before any dial."""
+
+    def __init__(self, *args, injector: FaultInjector, label: str,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.injector = injector
+        self.label = label
+        injector.register(label, self)
+
+    def dial(self, host: str, port: int):
+        if self.injector.refuse_dial(self.label, host, port):
+            return None
+        return super().dial(host, port)
+
+    def _register(self, peer) -> None:
+        if self.injector.refuse_peer(self.label, peer.node_id):
+            # an inbound upgrade (or a raced dial) crossed a cut link:
+            # drop it post-handshake, exactly like a firewalled RST
+            self.injector._note_refused()
+            peer.close()
+            return
+        raw_send = peer.send_gossip_rpc
+        peer.send_gossip_rpc = lambda framed: self.injector.on_gossip_frame(
+            self.label, self.injector.label_of(peer.node_id), raw_send,
+            framed)
+        super()._register(peer)

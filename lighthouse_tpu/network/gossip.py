@@ -110,6 +110,10 @@ class GossipEngine:
         self.subscriptions: set[str] = set()      # bare names
         self.validator = lambda topic, data: ("accept", None)
         self.on_message = lambda topic, data, peer, ctx: None
+        # fires when the validator IGNOREs a message but attaches a ctx —
+        # e.g. an unknown-parent block that sync should chase rather than
+        # forward (ignored messages are never propagated to the mesh)
+        self.on_ignored = lambda topic, data, peer, ctx: None
         self.on_validation_result = lambda peer, topic, result: None
         self.peer_score = lambda node_id: 0.0   # injected by the service
         self.mesh: dict[str, set[str]] = {}       # bare name -> node ids
@@ -325,6 +329,8 @@ class GossipEngine:
                 # forward to the topic mesh only (gossipsub), never flood
                 self.publish(topic, data, exclude_peer=peer.node_id)
                 self.on_message(topic, data, peer, ctx)
+            elif result == "ignore" and ctx is not None:
+                self.on_ignored(topic, data, peer, ctx)
 
     def _handle_graft(self, peer, topic_str: str) -> None:
         topic = self._bare(peer, topic_str)

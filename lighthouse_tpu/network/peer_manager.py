@@ -17,7 +17,12 @@ class PeerInfo:
 
 class PeerManager:
     BAN_THRESHOLD = -20.0
-    SCORES = {"reject": -5.0, "ignore": -0.5, "accept": 0.1,
+    # IGNORE is benign by the gossipsub validation contract (duplicates,
+    # not-yet-known head blocks): penalizing it makes every long-lived
+    # honest connection drift toward the ban threshold, since aggregates
+    # routinely cover already-seen attestations.  Only REJECT (provably
+    # invalid) and protocol abuse carry weight.
+    SCORES = {"reject": -5.0, "ignore": 0.0, "accept": 0.1,
               "rate_limited": -1.0, "timeout": -2.0, "bad_segment": -10.0,
               "empty_batch": -3.0}
 

@@ -35,14 +35,40 @@ class NetworkConfig:
     # reference's default per-node load); the ENR advertisement must
     # match what is actually subscribed
     subscribe_all_subnets: bool = True
+    # "noise" | "plaintext" | None (auto: noise when the cryptography
+    # package is available, else the plaintext fallback — transport.py)
+    security: str | None = None
+    # True -> attestation gossip defers SIGNATURE verification to the
+    # beacon processor's batch queues (structural checks stay inline on
+    # the socket thread); requires a processor.  This is the reference's
+    # batch path (batch.rs) and what the signature-flood scenario leans
+    # on: one multi-set verification per drained batch, per-item
+    # fallback splitting when a batch contains an invalid signature.
+    batch_gossip_verification: bool = False
+
+
+@dataclass
+class DeferredAttestation:
+    """Gossip attestation that passed structural checks inline; its
+    signature verification rides the processor's batch queue.  The
+    sender's node id rides along so a failed signature can still be
+    charged to the peer that gossiped it (the inline path reports
+    validation results synchronously; the batch path must not lose
+    that attribution)."""
+    attestation: object
+    subnet_id: int
+    peer_id: str | None = None
 
 
 class NetworkService:
     def __init__(self, chain, config: NetworkConfig | None = None,
-                 processor=None):
+                 processor=None, transport_factory=None):
         """`processor`: optional BeaconProcessor — accepted gossip is then
         imported through its priority queues (with attestation batching)
-        instead of inline on the socket reader thread."""
+        instead of inline on the socket reader thread.
+        `transport_factory`: optional (host, port) -> Transport hook so a
+        fault-injecting transport (network/faults.py) can be swapped in
+        without subclassing the service."""
         self.chain = chain
         self.config = config or NetworkConfig()
         self.processor = processor
@@ -54,7 +80,12 @@ class NetworkService:
             # chain hooks drive the park-and-replay queue (slot ticks +
             # block imports, work_reprocessing_queue.rs)
             chain.processor = processor
-        self.transport = Transport(self.config.host, self.config.port)
+        if transport_factory is not None:
+            self.transport = transport_factory(self.config.host,
+                                               self.config.port)
+        else:
+            self.transport = Transport(self.config.host, self.config.port,
+                                       security=self.config.security)
         digest = compute_fork_digest(
             chain.head().head_state.fork.current_version,
             chain.genesis_validators_root)
@@ -69,9 +100,14 @@ class NetworkService:
         self.transport.on_disconnect = self._on_disconnect
         self.gossip.validator = self._validate_gossip
         self.gossip.on_message = self._deliver_gossip
+        self.gossip.on_ignored = self._on_ignored_gossip
         self.gossip.on_validation_result = \
             lambda peer, topic, result: self.peers.report(peer.node_id,
                                                           result)
+        # unknown-parent chases in flight, keyed by block root (bounded:
+        # a spammer gossiping orphan blocks must not fan out lookups)
+        self._parent_lookups: set[bytes] = set()
+        self._parent_lookup_lock = threading.Lock()
         self.gossip.peer_score = self.peers.score
         self.rpc.on_rate_limited = \
             lambda peer, proto: self.peers.report(peer.node_id,
@@ -317,10 +353,34 @@ class NetworkService:
                 except BlockError as e:
                     if e.kind == "future_slot":
                         self._park_early_block(signed)
+                    elif e.kind == "parent_unknown":
+                        # a fork at our height gossips blocks whose whole
+                        # branch we missed (post-partition): range sync
+                        # never triggers (peer STATUS isn't ahead), so
+                        # the gossip pipeline must chase the ancestry —
+                        # hand the block to on_ignored for a parent
+                        # lookup against the peer that sent it
+                        return "ignore", ("unknown_parent", signed)
                     raise
                 return "accept", signed
             if topic.startswith("beacon_attestation_"):
                 att = deserialize(chain.T.Attestation.ssz_type, data)
+                if self.config.batch_gossip_verification and \
+                        self.processor is not None:
+                    from ..chain.attestation_verification import (
+                        verify_unaggregated_checks,
+                    )
+                    try:
+                        # structural checks inline (cheap rejects stay on
+                        # the socket thread); signature check deferred to
+                        # the processor's batch drain
+                        verify_unaggregated_checks(chain, att)
+                    except AttestationError as e:
+                        self._maybe_park_attestation(att, e,
+                                                     aggregated=False)
+                        raise
+                    subnet = int(topic.rsplit("_", 1)[-1])
+                    return "accept", DeferredAttestation(att, subnet)
                 try:
                     v = chain.verify_unaggregated_attestation_for_gossip(att)
                 except AttestationError as e:
@@ -439,6 +499,8 @@ class NetworkService:
                     WorkType.GOSSIP_BLOCK,
                     lambda: self._import_gossip_block(ctx, peer)))
             elif topic.startswith("beacon_attestation_"):
+                if isinstance(ctx, DeferredAttestation):
+                    ctx.peer_id = peer.node_id
                 self.processor.submit(Work(
                     WorkType.GOSSIP_ATTESTATION, lambda: None,
                     batchable_payload=ctx))
@@ -459,6 +521,36 @@ class NetworkService:
             logging.getLogger("lighthouse_tpu.network").exception(
                 "gossip delivery failed")
 
+    MAX_PARENT_LOOKUPS = 4
+
+    def _on_ignored_gossip(self, topic: str, data: bytes, peer,
+                           ctx) -> None:
+        """An IGNOREd message the validator wants chased: today that is
+        only ("unknown_parent", signed_block) — a fork branch we missed
+        entirely (e.g. the far side of a healed partition at equal
+        height, where no peer STATUS ever looks 'ahead' and range sync
+        stays idle).  Resolve it with a by-root ancestry walk against
+        the peer that gossiped the tip."""
+        if self._stopping or not isinstance(ctx, tuple) \
+                or ctx[0] != "unknown_parent":
+            return
+        signed = ctx[1]
+        root = htr(signed.message)
+        with self._parent_lookup_lock:
+            if root in self._parent_lookups \
+                    or len(self._parent_lookups) >= self.MAX_PARENT_LOOKUPS:
+                return
+            self._parent_lookups.add(root)
+        try:
+            self.sync.lookup_unknown_parent(root, peer.node_id)
+        except Exception:
+            import logging
+            logging.getLogger("lighthouse_tpu.network").exception(
+                "unknown-parent lookup failed (root %s)", root.hex())
+        finally:
+            with self._parent_lookup_lock:
+                self._parent_lookups.discard(root)
+
     def _import_gossip_block(self, signed, peer) -> None:
         try:
             self.chain.process_block(signed, proposal_already_verified=True)
@@ -472,9 +564,29 @@ class NetworkService:
         self.chain.add_to_op_pool(v)
 
     def _attestation_batch(self, verified_list) -> None:
+        deferred = []
         for v in verified_list:
-            if v is not None:
+            if isinstance(v, DeferredAttestation):
+                deferred.append(v)
+            elif v is not None:
                 self._apply_verified(v)
+        if deferred:
+            # one multi-set verification for the whole drained batch;
+            # invalid entries come back as AttestationError after the
+            # per-item fallback split (attestation_verification.py)
+            results = self.chain \
+                .batch_verify_unaggregated_attestations_for_gossip(
+                    [(d.attestation, d.subnet_id) for d in deferred])
+            for d, r in zip(deferred, results):
+                if not isinstance(r, Exception):
+                    self._apply_verified(r)
+                elif isinstance(r, AttestationError) \
+                        and r.kind == "bad_signature" \
+                        and d.peer_id is not None:
+                    # deferred-path parity with the inline path: a peer
+                    # gossiping provably invalid signatures is charged a
+                    # reject even though validation ran on the batch
+                    self.peers.report(d.peer_id, "reject")
 
     # -- publishing ----------------------------------------------------------
 

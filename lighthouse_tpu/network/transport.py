@@ -29,12 +29,14 @@ from . import multistream as ms
 from . import secp256k1
 from .gossipsub_pb import unframe
 from .noise_xx import (
-    NoiseError, NoiseSession, initiator_handshake, peer_id_from_pubkey,
-    responder_handshake,
+    HAVE_CRYPTOGRAPHY, NoiseError, NoiseSession, initiator_handshake,
+    peer_id_from_pubkey, responder_handshake,
 )
+from .plaintext import plaintext_handshake
 from .yamux import Session, Stream, StreamIO, YamuxError
 
 PROTO_NOISE = "/noise"
+PROTO_PLAINTEXT = "/plaintext/2.0.0"
 PROTO_YAMUX = "/yamux/1.0.0"
 PROTO_MESHSUB = ["/meshsub/1.2.0", "/meshsub/1.1.0"]
 
@@ -201,7 +203,21 @@ class Transport:
     `on_rpc_stream(peer, protocol, stream)`."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 identity: NodeIdentity | None = None):
+                 identity: NodeIdentity | None = None,
+                 security: str | None = None):
+        """`security`: "noise" | "plaintext" | None (auto: noise when the
+        cryptography package is available, else the plaintext fallback).
+        Both sides of a connection must agree — the chosen protocol is
+        what multistream offers, so a mismatch fails the negotiation
+        instead of silently downgrading."""
+        if security is None:
+            security = "noise" if HAVE_CRYPTOGRAPHY else "plaintext"
+        if security == "noise" and not HAVE_CRYPTOGRAPHY:
+            raise NoiseError("noise security requires the 'cryptography' "
+                             "package; use security='plaintext'")
+        if security not in ("noise", "plaintext"):
+            raise ValueError(f"unknown security mode {security!r}")
+        self.security = security
         self.identity = identity or NodeIdentity()
         self.node_id = self.identity.node_id
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -246,11 +262,16 @@ class Transport:
 
     # -- the upgrade path ------------------------------------------------------
 
+    def _security_proto(self) -> str:
+        return PROTO_NOISE if self.security == "noise" else PROTO_PLAINTEXT
+
     def _upgrade_in(self, sock, addr) -> None:
         try:
             sock.settimeout(10)
-            ms.negotiate_in(sock, [PROTO_NOISE])
-            session = responder_handshake(sock, self.identity.priv)
+            proto = ms.negotiate_in(sock, [self._security_proto()])
+            session = (responder_handshake(sock, self.identity.priv)
+                       if proto == PROTO_NOISE
+                       else plaintext_handshake(sock, self.identity.priv))
             io = _NoiseIO(sock, session)
             ms.negotiate_in(io, [PROTO_YAMUX])
             sock.settimeout(None)
@@ -262,8 +283,10 @@ class Transport:
         try:
             sock = socket.create_connection((host, port), timeout=5)
             sock.settimeout(10)
-            ms.negotiate_out(sock, [PROTO_NOISE])
-            session = initiator_handshake(sock, self.identity.priv)
+            proto = ms.negotiate_out(sock, [self._security_proto()])
+            session = (initiator_handshake(sock, self.identity.priv)
+                       if proto == PROTO_NOISE
+                       else plaintext_handshake(sock, self.identity.priv))
             io = _NoiseIO(sock, session)
             ms.negotiate_out(io, [PROTO_YAMUX])
             sock.settimeout(None)

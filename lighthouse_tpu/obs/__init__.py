@@ -8,6 +8,7 @@ from .jax_accounting import (
     account_transfer, host_readback, install_monitoring, snapshot as
     jax_counters, track_compiles,
 )
+from .capture import ScenarioTrace, scenario_capture
 from .report import render_table, summarize_chrome, summarize_spans
 from .tracing import (
     SPAN_KINDS, Span, annotate, attach, capture, chrome_trace, clear,
@@ -17,7 +18,8 @@ from .tracing import (
 __all__ = [
     "SPAN_KINDS", "Span", "annotate", "attach", "capture", "chrome_trace",
     "clear", "current_context", "current_span", "set_slot_clock",
-    "snapshot", "span", "account_transfer", "host_readback",
+    "snapshot", "span", "ScenarioTrace", "scenario_capture",
+    "account_transfer", "host_readback",
     "install_monitoring", "jax_counters", "track_compiles",
     "render_table", "summarize_chrome", "summarize_spans",
 ]

@@ -1,0 +1,61 @@
+"""Scenario-scoped trace capture.
+
+The adversarial scenarios (testing/scenarios.py) assert on graftscope
+output — p95 pipeline latency, span counts, queue behavior — not just on
+end-state liveness.  ``scenario_capture()`` brackets a scenario run and
+hands back only the spans that STARTED inside the bracket, so envelopes
+are not polluted by setup traffic (genesis import, initial dials) that
+happened before the faults were armed.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from . import tracing
+from .report import render_table, summarize_spans
+
+
+class ScenarioTrace:
+    """Spans captured during one scenario window, with the accessors the
+    degradation-envelope assertions use."""
+
+    def __init__(self, spans: list):
+        self.spans = spans
+        self.summary = summarize_spans(spans)
+
+    def count(self, kind: str) -> int:
+        row = self.summary.get(kind)
+        return int(row["count"]) if row else 0
+
+    def p95_ms(self, kind: str) -> float:
+        row = self.summary.get(kind)
+        return float(row["p95_ms"]) if row else 0.0
+
+    def max_ms(self, kind: str) -> float:
+        row = self.summary.get(kind)
+        return float(row["max_ms"]) if row else 0.0
+
+    def table(self) -> str:
+        return render_table(self.summary)
+
+
+@contextmanager
+def scenario_capture():
+    """Yield a ScenarioTrace that is filled in when the block exits.
+
+        with scenario_capture() as trace:
+            ...drive the scenario...
+        assert trace.p95_ms("block_pipeline") < 1500
+
+    The global ring buffer is not cleared — other captures (and the
+    /lighthouse/tracing endpoint) keep seeing the same spans; filtering
+    is by span start time."""
+    t0 = time.perf_counter()
+    trace = ScenarioTrace([])
+    try:
+        yield trace
+    finally:
+        spans = [s for s in tracing.snapshot() if s.start >= t0]
+        trace.spans = spans
+        trace.summary = summarize_spans(spans)

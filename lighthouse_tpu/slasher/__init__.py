@@ -6,4 +6,6 @@ a KV backend (the native C++ store). The matrix update is embarrassingly
 array-parallel — implemented as vectorized numpy sweeps (the second TPU
 workload candidate, SURVEY.md §7 step 9).
 """
-from .slasher import Slasher, SlasherConfig
+from .slasher import (
+    Slasher, SlasherConfig, SlashingRecord, record_to_operation,
+)

@@ -47,8 +47,25 @@ class SlasherConfig:
 class SlashingRecord:
     kind: str                  # "double" | "surrounds" | "surrounded"
     validator_index: int
-    attestation_1: object      # prior (indexed) attestation data snapshot
-    attestation_2: object      # new offender
+    attestation_1: object      # prior offending message (indexed attestation
+    attestation_2: object      # or signed header); attestation_2 is the new
+    #                            offender.  Both present => convertible into
+    #                            an on-chain slashing op (record_to_operation)
+
+
+def record_to_operation(record: SlashingRecord, T):
+    """Build the on-chain operation proving a slashing record, ready for
+    the op pool.  Only records carrying BOTH offending messages convert;
+    surround records found via the distance matrices know the prior vote
+    existed but not its content, so they cannot be packaged (the
+    reference re-fetches the indexed attestation from its DB — our
+    matrices store distances only)."""
+    a1, a2 = record.attestation_1, record.attestation_2
+    if a1 is None or a2 is None:
+        return None
+    if hasattr(a1, "attesting_indices"):
+        return T.AttesterSlashing(attestation_1=a1, attestation_2=a2)
+    return T.ProposerSlashing(signed_header_1=a1, signed_header_2=a2)
 
 
 class ChunkedArray:
@@ -206,7 +223,10 @@ class Slasher:
         # (validator, target) -> (data_root, data) for double-vote detection
         self._by_target: dict[tuple[int, int], tuple[bytes, object]] = {}
         self._queue: list = []
-        self._blocks: dict[tuple[int, int], bytes] = {}
+        # (slot, proposer) -> (header_root, signed_header): the header is
+        # kept so an equivocation record carries both signed messages
+        self._blocks: dict[tuple[int, int],
+                           tuple[bytes, object]] = {}
         self._block_queue: list = []
         self._lock = threading.Lock()
         self.slashings: list[SlashingRecord] = []
@@ -303,10 +323,10 @@ class Slasher:
         root = htr(h)
         prev = self._blocks.get(key)
         if prev is None:
-            self._blocks[key] = root
+            self._blocks[key] = (root, signed_header)
             return None
-        if prev != root:
-            return SlashingRecord("double", h.proposer_index, prev,
+        if prev[0] != root:
+            return SlashingRecord("double", h.proposer_index, prev[1],
                                   signed_header)
         return None
 

@@ -10,3 +10,4 @@ from .validator_store import ValidatorStore
 from .client import ValidatorClient, BeaconNodeInterface
 from .fallback import BeaconNodeFallback
 from .http_client import BeaconNodeHttpClient
+from .byzantine import ByzantineValidatorClient

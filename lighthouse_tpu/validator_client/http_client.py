@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import time
 from urllib.parse import urlencode, urlparse
 
 from ..containers import get_types
@@ -24,18 +25,25 @@ class HttpApiError(Exception):
 
 
 class BeaconNodeHttpClient(BeaconNodeInterface):
-    def __init__(self, url: str, spec: ChainSpec, timeout: float = 10.0):
+    def __init__(self, url: str, spec: ChainSpec, timeout: float = 10.0,
+                 retries: int = 2, backoff: float = 0.1):
         p = urlparse(url)
         self.host = p.hostname or "127.0.0.1"
         self.port = p.port or 5052
         self.timeout = timeout
+        self.retries = retries          # extra attempts after the first
+        self.backoff = backoff          # base delay, doubled per attempt
+        self.retry_count = 0
         self.spec = spec
         self.T = get_types(spec.preset)
 
     def _req(self, method: str, path: str, body: bytes | None = None,
              json_body=None, raw: bool = False):
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
+        """One request with bounded connection-level retries.  Only
+        transport failures (refused/reset/timeout — OSError family) are
+        retried: an HTTP status >= 400 means the BN heard us and said no,
+        and blindly re-POSTing a block or attestation would not change
+        its mind (the eth2 client's no-retry-on-4xx discipline)."""
         headers = {}
         if raw:
             # SSZ responses are opt-in since round 4 (the server
@@ -46,15 +54,29 @@ class BeaconNodeHttpClient(BeaconNodeInterface):
             headers["Content-Type"] = "application/json"
         elif body is not None:
             headers["Content-Type"] = "application/octet-stream"
-        try:
-            conn.request(method, path, body=body, headers=headers)
-            r = conn.getresponse()
-            data = r.read()
-            if r.status >= 400:
-                raise HttpApiError(r.status, data[:200].decode("latin1"))
-            return data if raw else (json.loads(data) if data else {})
-        finally:
-            conn.close()
+        last_err: Exception | None = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                self.retry_count += 1
+                from ..api import metrics_defs as M
+                M.count("vc_http_retries_total")
+                time.sleep(self.backoff * (2 ** (attempt - 1)))
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                r = conn.getresponse()
+                data = r.read()
+                if r.status >= 400:
+                    raise HttpApiError(r.status,
+                                       data[:200].decode("latin1"))
+                return data if raw else (json.loads(data) if data else {})
+            except (OSError, TimeoutError, http.client.HTTPException) as e:
+                last_err = e
+            finally:
+                conn.close()
+        assert last_err is not None
+        raise last_err
 
     # -- BeaconNodeInterface -------------------------------------------------
 

@@ -70,6 +70,29 @@ class Queue:
             self._submit(w)  # seeded
 
 
+class Fabric:
+    """Injector-shaped (network/faults.py): a teardown path severs
+    registered transports while the frame pump may still spawn
+    delivery work from another thread."""
+
+    def __init__(self, threads):
+        self._threads = threads
+        self._transports = {}
+        self._halted = False
+
+    def teardown(self):
+        self._halted = True
+        self._transports.clear()
+
+    def release_frames(self, fn):
+        if self._halted:
+            return
+        self._threads.spawn(fn)      # guard checked above: fine
+
+    def flush(self, fn):
+        self._threads.spawn(fn)  # seeded
+
+
 class Plain:
     """No stop path, no injected callable: out of the bug class."""
 
