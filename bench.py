@@ -321,7 +321,7 @@ def bench_state_transition():
     stages = {}
     t0 = time.perf_counter()
     pre = state.copy()
-    stages["state_copy_ms"] = (time.perf_counter() - t0) * 1000
+    stages["state_copy_ms"] = round((time.perf_counter() - t0) * 1000, 2)
 
     # untimed warmup: faults the copied columns in, and primes the
     # shared shuffling cache + pubkey index for every timed rep
@@ -350,12 +350,82 @@ def bench_state_transition():
                   n_validators=n):
         per_epoch_processing(ep)
     epoch_ms = (time.perf_counter() - t0) * 1000
+
+    with obs.span("bench_stage", stage="fork_fanout"):
+        stages["fork_fanout"] = _bench_fork_fanout(state)
     return {
         "epoch_ms": round(epoch_ms, 1),
         "block_import_ms": block_ms,
         "n_validators": n,
         "sig_backend": "fake",
         "stages": stages,
+    }
+
+
+def _bench_fork_fanout(pre, n_forks=32, mutations_per_fork=4):
+    """CoW fork fan-out: ``n_forks`` live copies of one primed state,
+    each with a few point mutations (balances scatter + one registry
+    set_field), then a per-copy incremental hash_tree_root against the
+    SHARED merkle trees.  Reports total extra RSS vs the size of one
+    full state (acceptance: <= 15%) and the CoW chunk counters
+    (acceptance: chunks_shared >> chunks_materialized)."""
+    import gc
+    import numpy as np
+    from lighthouse_tpu.containers import cow
+
+    def rss_bytes():
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+
+    pre.hash_tree_root()        # prime + share the incremental trees
+    v = pre.validators
+    full_state_mb = (sum(getattr(v, c).nbytes for c in v.COLUMNS)
+                     + pre.balances.nbytes + pre.inactivity_scores.nbytes
+                     + pre.previous_epoch_participation.nbytes
+                     + pre.current_epoch_participation.nbytes) / 1e6
+    rng = np.random.default_rng(11)
+    n = len(pre.balances)
+
+    def make_fork(i):
+        f = pre.copy()
+        rows = np.unique(rng.integers(0, n, size=mutations_per_fork))
+        f.balances[rows] = f.balances[rows] + np.uint64(1 + i)
+        f.validators.set_field(int(rows[0]), "exit_epoch", 500_000 + i)
+        return f
+
+    # warmup fork: pays one-time costs (compiled hash programs, lazily
+    # built buffers) outside the RSS window
+    w = make_fork(999)
+    w.hash_tree_root()
+    del w
+    gc.collect()
+    stats0 = dict(cow.STATS)
+    rss0 = rss_bytes()
+    t0 = time.perf_counter()
+    forks = [make_fork(i) for i in range(n_forks)]
+    fork_ms = (time.perf_counter() - t0) * 1000
+    htr_ms, roots = [], set()
+    for f in forks:
+        t0 = time.perf_counter()
+        roots.add(f.hash_tree_root())
+        htr_ms.append((time.perf_counter() - t0) * 1000)
+    gc.collect()
+    rss_delta_mb = max(0, rss_bytes() - rss0) / 1e6
+    delta = {k: cow.STATS[k] - stats0[k] for k in cow.STATS}
+    htr_ms.sort()
+    return {
+        "n_forks": n_forks,
+        "mutations_per_fork": mutations_per_fork,
+        "distinct_roots": len(roots),
+        "fork_plus_mutate_ms_total": round(fork_ms, 2),
+        "htr_ms_median": round(htr_ms[len(htr_ms) // 2], 2),
+        "htr_ms_max": round(htr_ms[-1], 2),
+        "rss_delta_mb": round(rss_delta_mb, 2),
+        "full_state_mb": round(full_state_mb, 1),
+        "rss_delta_pct_of_state":
+            round(100 * rss_delta_mb / full_state_mb, 2),
+        "chunks_shared": delta["chunks_shared"],
+        "chunks_materialized": delta["chunks_materialized"],
     }
 
 
@@ -430,6 +500,10 @@ def child_main():
             "n_validators": stf["n_validators"],
             "sig_backend": stf["sig_backend"],
             "stf_stages": stf["stages"],
+            "state_copy_ms": stf["stages"]["state_copy_ms"],
+            "state_copy_gate_ms": 60.0,
+            "state_copy_gate_pass":
+                stf["stages"]["state_copy_ms"] <= 60.0,
         }
     elif mode == "mxu":
         mm = bench_mont_mul_modes()
@@ -645,6 +719,11 @@ def main():
                         stf_rec.get("n_validators")
                     rec["stf_sig_backend"] = stf_rec.get("sig_backend")
                     rec["stf_stages"] = stf_rec.get("stf_stages")
+                    rec["state_copy_ms"] = stf_rec.get("state_copy_ms")
+                    rec["state_copy_gate_ms"] = \
+                        stf_rec.get("state_copy_gate_ms")
+                    rec["state_copy_gate_pass"] = \
+                        stf_rec.get("state_copy_gate_pass")
                 mxu_rec = _mxu_record(force_cpu)
                 if mxu_rec is not None and mxu_rec.get("value"):
                     rec["mont_mul_per_sec"] = \

@@ -1,6 +1,7 @@
 """Rule modules register themselves with the engine on import."""
 from . import (  # noqa: F401
     compile_budget,
+    cow_discipline,
     device_transfer,
     lock_discipline,
     lock_order,

@@ -112,6 +112,14 @@ CATALOG: dict[str, tuple[str, str]] = {
     "bls_batch_verify_sigs": ("hist", "Signatures per device batch"),
     "bls_device_pairing_seconds": ("hist", "Device pairing-check latency"),
     "tree_hash_root_seconds": ("hist", "BeaconState tree_hash latency"),
+    # -- CoW state columns (containers/cow.py) ----------------------------
+    "state_copy_seconds":
+        ("hist", "BeaconState.copy latency (CoW fork of every column)"),
+    "state_cow_chunks_materialized":
+        ("counter", "CoW chunks privatized by writes (copied out of a "
+                    "shared column)"),
+    "state_cow_chunks_shared":
+        ("counter", "CoW chunks shared by reference at fork time"),
     "kzg_blob_verification_seconds": ("hist", "Blob batch verify latency"),
     # -- execution layer --------------------------------------------------
     "execution_layer_new_payload_seconds":

@@ -279,8 +279,11 @@ class BeaconChain:
             raise BlockError(PARENT_UNKNOWN, data.beacon_block_root.hex())
         target_start = compute_start_slot_at_epoch(
             data.target.epoch, self.spec.preset.slots_per_epoch)
+        # always hand back an isolated fork: a CoW copy is O(chunks)
+        # pointer work now, and callers shuffling committees must never
+        # alias the snapshot-cache state
+        st = st.copy()
         if st.slot < target_start:
-            st = st.copy()
             process_slots(st, target_start)
         return st
 

@@ -15,6 +15,8 @@ dirty tracking plays the role of milhouse's lazily-flushed tree caches.
 """
 from __future__ import annotations
 
+import sys
+import time
 from dataclasses import dataclass, field as dfield
 from typing import Any
 
@@ -51,6 +53,7 @@ def _use_host_hash() -> bool:
                 _USE_HOST_HASH = True
     return _USE_HOST_HASH
 from .core import Types, get_types
+from .cow import CowColumn
 
 
 def _np_bytes32_root(arr: np.ndarray, limit: int | None,
@@ -107,6 +110,14 @@ class ValidatorRegistry:
                "slashed", "activation_eligibility_epoch", "activation_epoch",
                "exit_epoch", "withdrawable_epoch")
 
+    def __setattr__(self, name, value):
+        # column rebinds (appends, epoch sweeps, test fixtures) land as
+        # CoW columns so copy() is chunk-pointer work, not 128 MB memcpy
+        if name in ValidatorRegistry.COLUMNS and \
+                not isinstance(value, CowColumn):
+            value = CowColumn(value)
+        object.__setattr__(self, name, value)
+
     def __init__(self, n: int = 0):
         self.pubkeys = np.zeros((n, 48), dtype=np.uint8)
         self.withdrawal_credentials = np.zeros((n, 32), dtype=np.uint8)
@@ -138,6 +149,14 @@ class ValidatorRegistry:
             self._dirty_rows = None        # full rebuild
         elif self._dirty_rows is not None:
             self._dirty_rows.add(row)
+
+    def mark_dirty_many(self, rows) -> None:
+        """Vector form of mark_dirty for chunk-scatter column writes
+        (effective-balance hysteresis sweep and friends)."""
+        self._dirty = True
+        if self._dirty_rows is not None:
+            self._dirty_rows.update(
+                np.unique(np.asarray(rows, np.int64)).tolist())
 
     def index_of(self, pubkey: bytes) -> int | None:
         """Pubkey -> validator index (the ValidatorPubkeyCache analog,
@@ -199,7 +218,7 @@ class ValidatorRegistry:
     def copy(self) -> "ValidatorRegistry":
         out = ValidatorRegistry.__new__(ValidatorRegistry)
         for c in self.COLUMNS:
-            setattr(out, c, getattr(self, c).copy())
+            object.__setattr__(out, c, getattr(self, c).fork())
         out._dirty = self._dirty
         out._root_cache = self._root_cache
         # share the device tree, flagged so the next update on either copy
@@ -217,6 +236,12 @@ class ValidatorRegistry:
         if host is not None:
             self._host_shared = True
         out._host_shared = host is not None
+        # pubkeys are append-only and immutable per row, so the
+        # pubkey->index dict stays valid for both sides (and is seconds
+        # of rebuild at 1M validators) — share it
+        pk = getattr(self, "_pk_index", None)
+        if pk is not None:
+            object.__setattr__(out, "_pk_index", pk)
         return out
 
     # -- merkleization -------------------------------------------------------
@@ -315,11 +340,19 @@ class ValidatorRegistry:
                                           registry_limit)
             self._host_shared = False
         elif dirty:
-            if getattr(self, "_host_shared", False):
-                self._host_tree = self._host_tree.copy()
-                self._host_shared = False
             rows = np.fromiter(dirty, dtype=np.int64)
             rows.sort()
+            if getattr(self, "_host_shared", False):
+                from .cow import OVERLAY_MAX_LEAVES
+                if len(rows) <= OVERLAY_MAX_LEAVES:
+                    # fork fan-out: resolve dirty rows against the SHARED
+                    # tree read-only (no ~2x-leaf-bytes level clone per
+                    # fork); the dirty set stays pending
+                    return mix_in_length(
+                        nh.overlay_root(self._host_tree, rows,
+                                        self._validator_roots(rows)), n)
+                self._host_tree = self._host_tree.copy()
+                self._host_shared = False
             self._host_tree.update(rows, self._validator_roots(rows))
         self._dirty_rows = set()
         self._device_tree = None     # consumed the dirty set
@@ -678,13 +711,24 @@ def active_field_specs(T: Types, fork: ForkName) -> list[FieldSpec]:
 
 
 # n-sized packed columns with incremental trees:
-# field -> (cache attr, element dtype) — ONE source of truth for both
-# the __setattr__ normalization and the _column_root cache construction
+# field -> (cache attr, element dtype) — bound as hashed CowColumns by
+# __setattr__; the legacy *_cache mirror attrs now point at the column
+# itself (tests reset them; the root path no longer depends on them)
 _COLUMN_CACHES = {
     "balances": ("_balances_cache", np.uint64),
     "inactivity_scores": ("_inactivity_cache", np.uint64),
     "previous_epoch_participation": ("_prev_part_cache", np.uint8),
     "current_epoch_participation": ("_curr_part_cache", np.uint8),
+}
+
+# fixed-length vector columns, CoW-wrapped (non-hashed) so copy() stays
+# O(chunks) — randao_mixes alone is 2 MB/copy at mainnet shape; their
+# roots remain full recomputes (_np_*_root) like before
+_VEC_COLUMNS = {
+    "block_roots": np.uint8,
+    "state_roots": np.uint8,
+    "randao_mixes": np.uint8,
+    "slashings": np.uint64,
 }
 
 
@@ -699,44 +743,46 @@ class BeaconState:
     (``state.balances = arr``) are caught by ``__setattr__`` and trigger
     a full rebuild."""
 
-    _balances_cache: "BalancesColumn | None" = None
-    _inactivity_cache: "BalancesColumn | None" = None
-    _prev_part_cache: "BalancesColumn | None" = None
-    _curr_part_cache: "BalancesColumn | None" = None
+    # legacy mirror attrs: now the bound CowColumn itself (tests null
+    # them; the root path reads the field directly)
+    _balances_cache: "CowColumn | None" = None
+    _inactivity_cache: "CowColumn | None" = None
+    _prev_part_cache: "CowColumn | None" = None
+    _curr_part_cache: "CowColumn | None" = None
 
     def __setattr__(self, name, value):
         if name in _COLUMN_CACHES:
             attr, dtype = _COLUMN_CACHES[name]
-            object.__setattr__(self, attr, None)
-            # normalize so BalancesColumn(value) aliases rather than
-            # copies — a copy would defeat the `cache.values is v`
-            # freshness check and silently degrade to full rebuilds
-            if isinstance(value, np.ndarray):
-                value = np.ascontiguousarray(value, dtype=dtype)
+            # n-sized columns live as hashed CoW columns: writes through
+            # the column API feed one dirty set for both copy and hash
+            if value is not None and not isinstance(value, CowColumn):
+                value = CowColumn(value, dtype=dtype, hashed=True)
+            object.__setattr__(self, attr, value)
+        elif name in _VEC_COLUMNS and value is not None and \
+                not isinstance(value, CowColumn):
+            value = CowColumn(value, dtype=_VEC_COLUMNS[name])
         object.__setattr__(self, name, value)
 
     def mark_balances_dirty(self, index: int) -> None:
-        cache = self._balances_cache
-        if cache is not None:
-            cache.mark_dirty(index)
+        """Compatibility hook — writes through the column API already
+        record themselves; keeps the discipline explicit at call sites."""
+        col = self.balances
+        if isinstance(col, CowColumn):
+            col.mark_dirty(int(index))
 
     def mark_participation_dirty(self, indices, current: bool) -> None:
         """In-place participation-flag mutations (process_attestation)
-        must report the touched rows here, mirroring the balances
-        discipline."""
-        cache = self._curr_part_cache if current else self._prev_part_cache
-        if cache is not None:
-            cache.mark_dirty_many(indices)
+        report the touched rows here, mirroring the balances
+        discipline (idempotent over the column's own write tracking)."""
+        col = (self.current_epoch_participation if current
+               else self.previous_epoch_participation)
+        if isinstance(col, CowColumn):
+            col.mark_dirty_many(indices)
 
     def rotate_participation(self) -> None:
-        """Epoch rotation: previous <- current with the primed tree
-        cache handed off O(1) (the installed array IS the one the
-        current-cache holds a complete tree for), current <- zeros."""
-        cache = self._curr_part_cache
+        """Epoch rotation: previous <- current (the CowColumn carries
+        its primed incremental tree across, O(1)), current <- zeros."""
         self.previous_epoch_participation = self.current_epoch_participation
-        if cache is not None and \
-                cache.values is self.previous_epoch_participation:
-            object.__setattr__(self, "_prev_part_cache", cache)
         self.current_epoch_participation = np.zeros(
             len(self.validators), np.uint8)
 
@@ -863,17 +909,21 @@ class BeaconState:
 
     # -- copy ----------------------------------------------------------------
     def copy(self) -> "BeaconState":
+        t0 = time.perf_counter()
         out = BeaconState.__new__(BeaconState)
         out.T, out.spec, out.fork_name = self.T, self.spec, self.fork_name
         for f in active_field_specs(self.T, self.fork_name):
             v = getattr(self, f.name)
-            if isinstance(v, np.ndarray):
+            if isinstance(v, CowColumn):
+                v = v.fork()
+            elif isinstance(v, np.ndarray):
                 v = v.copy()
             elif isinstance(v, ValidatorRegistry):
                 v = v.copy()
             elif isinstance(v, list):
-                v = [e.copy() if hasattr(e, "copy") and not isinstance(e, (bytes, int)) else e
-                     for e in v]
+                # ssz_list entries are frozen (the STF rebinds, never
+                # mutates elements in place): share them, copy the spine
+                v = list(v)
             elif hasattr(v, "copy") and not isinstance(v, (bytes, int)):
                 v = v.copy()
             setattr(out, f.name, v)
@@ -881,13 +931,9 @@ class BeaconState:
         for f in state_field_specs(self.T):
             if not hasattr(out, f.name):
                 setattr(out, f.name, None)
-        # share the packed-column tree caches copy-on-write over the
-        # copied arrays (balances, inactivity, participation)
-        for field, (attr, _dt) in _COLUMN_CACHES.items():
-            cache = getattr(self, attr)
-            if cache is not None and getattr(out, field, None) is not None:
-                object.__setattr__(out, attr,
-                                   cache.fork(getattr(out, field)))
+        m = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+        if m is not None:
+            m.observe("state_copy_seconds", time.perf_counter() - t0)
         return out
 
     # -- merkleization -------------------------------------------------------
@@ -909,29 +955,19 @@ class BeaconState:
         if f.kind == "u64_vec":
             return _np_uint_root(v, (f.limit * 8 + 31) // 32)
         if f.kind == "u64_list":
-            if f.name in _COLUMN_CACHES and len(v):
-                return self._column_root(f, v, np.uint64)
+            if isinstance(v, CowColumn):
+                # incremental root off the column's own dirty-leaf set —
+                # the same bookkeeping its writes feed (no identity-keyed
+                # cache invalidation anymore)
+                return v.hash_tree_root(f.limit)
             return _np_uint_root(v, (f.limit * 8 + 31) // 32, length=len(v))
         if f.kind == "u8_list":
-            if f.name in _COLUMN_CACHES and len(v):
-                return self._column_root(f, v, np.uint8)
+            if isinstance(v, CowColumn):
+                return v.hash_tree_root(f.limit)
             return _np_uint_root(v, (f.limit + 31) // 32, length=len(v))
         if f.kind == "validators":
             return v.hash_tree_root(f.limit)
         raise TypeError(f.kind)
-
-    def _column_root(self, f: FieldSpec, v: np.ndarray, dtype) -> bytes:
-        """Incremental packed-column root (balances, inactivity_scores,
-        participation): the cache is keyed on ARRAY IDENTITY, so
-        wholesale replacements (epoch sweeps, appends) rebuild and
-        unchanged columns reuse the cached root; in-place point
-        mutations must go through the mark_*_dirty hooks."""
-        attr, _dtype = _COLUMN_CACHES[f.name]
-        cache = getattr(self, attr)
-        if cache is None or cache.values is not v:
-            cache = BalancesColumn(v, dtype=dtype)
-            object.__setattr__(self, attr, cache)
-        return cache.hash_tree_root(f.limit)
 
     def hash_tree_root(self) -> bytes:
         # graftscope: the state root is a north-star hot spot — every

@@ -163,7 +163,13 @@ def _process_inactivity_updates(state: BeaconState) -> None:
             eligible,
             scores - np.minimum(p.inactivity_score_recovery_rate, scores),
             scores)
-    state.inactivity_scores = scores.astype(np.uint64)
+    # chunk-scatter the changed rows instead of rebinding the column:
+    # steady state most scores stay 0, so the CoW column keeps its
+    # shared chunks and the incremental tree only re-hashes the delta
+    new = scores.astype(np.uint64)
+    changed = np.flatnonzero(new != state.inactivity_scores)
+    if len(changed):
+        state.inactivity_scores[changed] = new[changed]
 
 
 def _inactivity_penalty_quotient(p, fork: ForkName) -> int:
@@ -297,9 +303,13 @@ def _process_slashings(state: BeaconState, fork: ForkName,
         penalties = (eb // inc) * per_increment
     else:
         penalties = (eb // inc) * adjusted // total_active * inc
-    balances = state.balances.astype(np.int64)
-    state.balances = np.maximum(
-        0, balances - np.where(mask, penalties, 0)).astype(np.uint64)
+    rows = np.flatnonzero(mask)
+    if len(rows):
+        # scatter-write only the slashed validators' balances (the mask
+        # is sparse; a wholesale rebind would drop the shared chunks)
+        bal = state.balances[rows].astype(np.int64)
+        state.balances[rows] = np.maximum(
+            0, bal - penalties[rows]).astype(np.uint64)
 
 
 def _process_eth1_data_reset(state: BeaconState) -> None:
@@ -332,10 +342,11 @@ def _process_effective_balance_updates(state: BeaconState) -> None:
     updated = np.where(needs, new_eb, eb).astype(np.uint64)
     changed = np.flatnonzero(updated != v.effective_balance)
     if len(changed):
-        v.effective_balance = updated
+        # chunk-scatter write through the CoW column + vector dirty mark
+        # (rebinding would orphan the shared chunks of the whole column)
+        v.effective_balance[changed] = updated[changed]
         if len(changed) * 8 < len(v):
-            for i in changed:
-                v.mark_dirty(int(i))
+            v.mark_dirty_many(changed)
         else:
             v.mark_dirty()
 

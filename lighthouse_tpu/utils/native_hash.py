@@ -157,3 +157,38 @@ class HostTree:
         return r
 
 
+def overlay_root(tree: HostTree, idx: np.ndarray,
+                 new_chunks: np.ndarray) -> bytes:
+    """Root of ``tree`` with the chunks at ``idx`` replaced by
+    ``new_chunks`` — WITHOUT mutating or cloning the tree.
+
+    A sparse overlay of changed nodes is carried up level by level,
+    reading every untouched sibling from the shared levels.  This is the
+    fork fan-out path: dozens of live state copies can each report an
+    incremental root against ONE shared tree, paying O(dirty * depth)
+    hashes and zero level memory instead of HostTree.copy()'s 2x padded
+    leaf bytes per fork."""
+    overlay = {int(i): new_chunks[j].tobytes()
+               for j, i in enumerate(np.asarray(idx, np.int64))}
+    for li in range(1, len(tree.levels)):
+        prev = tree.levels[li - 1]
+        parents = sorted({i >> 1 for i in overlay})
+        buf = np.empty((len(parents), 64), np.uint8)
+        for j, p in enumerate(parents):
+            left = overlay.get(2 * p)
+            buf[j, :32] = (np.frombuffer(left, np.uint8)
+                           if left is not None else prev[2 * p])
+            right = overlay.get(2 * p + 1)
+            buf[j, 32:] = (np.frombuffer(right, np.uint8)
+                           if right is not None else prev[2 * p + 1])
+        out = hash64_batch(buf.tobytes())
+        overlay = {p: out[32 * j:32 * j + 32]
+                   for j, p in enumerate(parents)}
+    r = overlay.get(0, tree.levels[-1][0].tobytes())
+    dense_depth = (int(tree.levels[0].shape[0]) - 1).bit_length()
+    from .hash import ZERO_HASHES, hash_concat
+    for d in range(dense_depth, tree.limit_depth):
+        r = hash_concat(r, ZERO_HASHES[d])
+    return r
+
+

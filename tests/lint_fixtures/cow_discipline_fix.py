@@ -1,0 +1,44 @@
+"""Seeded fixture for the cow-discipline rule.
+
+True positives are tagged ``seeded``: in-place writes that reach into
+CoW column internals or write through a densified ``asarray`` alias,
+bypassing chunk privatization and dirty-leaf tracking.  AST-scanned
+only, never imported.
+"""
+import numpy as np
+
+
+def bad_internal_reach(state, rows, values):
+    state.balances._base[rows] = values  # seeded
+    state.balances._chunks[0][3] = 7  # seeded
+    state.validators.effective_balance._base[0] += 1  # seeded
+
+
+def bad_densified_alias(state, rows, values):
+    np.asarray(state.balances)[rows] = values  # seeded
+    np.ascontiguousarray(state.current_epoch_participation)[rows] |= 4  # seeded
+
+
+# -- true negatives ----------------------------------------------------------
+
+class CowishColumn:
+    def __init__(self, base, chunks):
+        self._base = base
+        self._chunks = chunks
+
+    def _writable(self, c, o, value):
+        # the column's own implementation IS the write API
+        self._base[c] = value
+        self._chunks[c][o] = value
+
+
+def good_column_api(state, rows, values):
+    state.balances[rows] = values                  # the chunk-write API
+    state.balances.mark_dirty_many(rows)
+    part = np.asarray(state.previous_epoch_participation)
+    return part[rows]                              # densified READS are fine
+
+
+def good_unrelated_subscript(table, rows, values):
+    table["base"][rows] = values
+    np.asarray(values)[rows] = 0                   # not a CoW column field

@@ -38,6 +38,7 @@ RULE_FIXTURE = {
     "lock-order": "lock_order_fix.py",
     "shutdown-order": "shutdown_order_fix.py",
     "compile-budget": "compile_budget_fix.py",
+    "cow-discipline": "cow_discipline_fix.py",
 }
 
 
