@@ -533,6 +533,150 @@ def child_main():
 
 
 # --------------------------------------------------------------------------
+# regression gate: bench.py --against <record|auto> [--record <new>]
+# --------------------------------------------------------------------------
+
+#: fractional slowdown on any gated metric that fails the gate
+REGRESSION_LIMIT = 0.25
+
+#: (record key — dotted for nesting, direction, platform-label key).
+#: Accelerator-measured metrics are only comparable when both records
+#: ran them on the same platform; the gate skips them (with a note)
+#: rather than fail a CPU-fallback run against a TPU record.
+GATED_METRICS = [
+    ("value", "lower", "platform"),                    # tree-hash ms
+    ("bls_sigs_per_sec", "higher", "bls_platform"),
+    ("epoch_ms_1m", "lower", None),                    # STF is host-side
+    ("block_import_ms_1m.signatures_off", "lower", None),
+    ("state_copy_ms", "lower", None),
+    ("mxu_mode_speedup", "higher", "mxu_platform"),
+]
+
+
+def _get_path(rec, dotted):
+    cur = rec
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return float(cur) if isinstance(cur, (int, float)) else None
+
+
+def compare_records(old: dict, new: dict,
+                    limit: float = REGRESSION_LIMIT) -> dict:
+    """Diff two bench records over GATED_METRICS.  Returns a report dict;
+    report["ok"] is False when any gated metric regressed past `limit`."""
+    compared, skipped = [], []
+    for key, direction, plat_key in GATED_METRICS:
+        ov, nv = _get_path(old, key), _get_path(new, key)
+        if ov is None or nv is None or ov <= 0 or nv <= 0:
+            skipped.append({"metric": key,
+                            "why": "missing or non-positive in one record"})
+            continue
+        if plat_key is not None and old.get(plat_key) != new.get(plat_key):
+            skipped.append({"metric": key,
+                            "why": f"platform mismatch "
+                                   f"({old.get(plat_key)} vs "
+                                   f"{new.get(plat_key)})"})
+            continue
+        # normalize both directions to "fraction slower than before"
+        change = (nv / ov - 1.0) if direction == "lower" \
+            else (ov / nv - 1.0)
+        if change > limit:
+            status = "regression"
+        elif change < 0:
+            status = "improvement"
+        else:
+            status = "within_limit"
+        compared.append({"metric": key, "direction": direction,
+                         "old": ov, "new": nv,
+                         "change_pct": round(100 * change, 1),
+                         "status": status})
+    regressions = [c["metric"] for c in compared
+                   if c["status"] == "regression"]
+    return {"mode": "against", "limit_pct": round(limit * 100, 1),
+            "compared": compared, "skipped": skipped,
+            "regressions": regressions, "ok": not regressions}
+
+
+def _unwrap_record(doc: dict) -> dict:
+    """Driver-written BENCH_r*.json wraps the bench JSON line under
+    "parsed" (alongside rc/tail); accept either shape."""
+    if isinstance(doc, dict) and "metric" not in doc \
+            and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
+
+
+def _latest_record_path():
+    import glob
+    import re
+    best, best_n = None, -1
+    for p in glob.glob(os.path.join(_REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m and int(m.group(1)) > best_n:
+            best, best_n = p, int(m.group(1))
+    return best
+
+
+def _against_main(argv):
+    """`--against auto|<old.json>` compares a fresh record (or
+    `--record <new.json>`) to a previous one and exits 1 on any >25%
+    regression of a gated metric.  Prints the report as JSON."""
+    def _arg(flag):
+        i = argv.index(flag)
+        if i + 1 >= len(argv):
+            print(json.dumps({"mode": "against", "ok": False,
+                              "error": f"{flag} needs a value"}))
+            sys.exit(2)
+        return argv[i + 1]
+
+    old_path = _arg("--against")
+    if old_path == "auto":
+        old_path = _latest_record_path()
+        if old_path is None:
+            print(json.dumps({"mode": "against", "ok": False,
+                              "error": "no BENCH_r*.json record found"}))
+            sys.exit(2)
+    try:
+        with open(old_path) as f:
+            old = _unwrap_record(json.load(f))
+    except (OSError, ValueError) as exc:
+        print(json.dumps({"mode": "against", "ok": False,
+                          "error": f"cannot load {old_path}: {exc}"}))
+        sys.exit(2)
+    if "--record" in argv:
+        new_source = _arg("--record")
+        try:
+            with open(new_source) as f:
+                new = _unwrap_record(json.load(f))
+        except (OSError, ValueError) as exc:
+            print(json.dumps({"mode": "against", "ok": False,
+                              "error": f"cannot load {new_source}: {exc}"}))
+            sys.exit(2)
+    else:
+        # fresh measurement: re-run ourselves without --against so the
+        # whole fallback orchestration above is reused verbatim
+        new_source = "fresh run"
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              cwd=_REPO, env=dict(os.environ),
+                              capture_output=True, text=True)
+        new = _parse_record(proc.stdout)
+        if new is None:
+            print(json.dumps({"mode": "against", "ok": False,
+                              "error": "fresh bench run produced no "
+                                       "record: " + proc.stderr[-500:]}))
+            sys.exit(2)
+    limit = float(os.environ.get("LHTPU_BENCH_REGRESSION_LIMIT",
+                                 REGRESSION_LIMIT))
+    report = compare_records(old, new, limit)
+    report["old_file"] = old_path
+    report["new_source"] = new_source
+    print(json.dumps(report, indent=1))
+    sys.exit(0 if report["ok"] else 1)
+
+
+# --------------------------------------------------------------------------
 # parent: orchestration (never imports jax)
 # --------------------------------------------------------------------------
 
@@ -676,6 +820,8 @@ def _mxu_record(force_cpu: bool):
 
 
 def main():
+    if "--against" in sys.argv:
+        return _against_main(sys.argv)
     if "--trace" in sys.argv:
         # children inherit via _child_env(dict(os.environ)) and write
         # BENCH_TRACE_<mode>.json + _summary.json next to BENCH_*.json

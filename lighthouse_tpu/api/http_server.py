@@ -456,7 +456,34 @@ def build_get_routes(backend: ApiBackend):
          lambda m, q: {"data": obs.summarize_spans(obs.snapshot())}),
         (re.compile(r"^/lighthouse/tracing/jax$"),
          lambda m, q: {"data": obs.jax_counters()}),
+        # -- graftwatch (obs/graftwatch; see OBSERVABILITY.md) ---------------
+        # slo: per-objective status; series: one ring (?name=...) or the
+        # available names; incidents: open + resolved; dump: a full
+        # flight-recorder document built on demand (pure read — POST-free
+        # diagnosis; SIGUSR2 / incident auto-dump write to disk instead)
+        (re.compile(r"^/lighthouse/graftwatch/slo$"),
+         lambda m, q: {"data": obs.graftwatch.get().engine.status()}),
+        (re.compile(r"^/lighthouse/graftwatch/series$"),
+         lambda m, q: {"data": _graftwatch_series(q)}),
+        (re.compile(r"^/lighthouse/graftwatch/incidents$"),
+         lambda m, q: {"data": [i.to_dict() for i in
+                                obs.graftwatch.get().engine
+                                .all_incidents()]}),
+        (re.compile(r"^/lighthouse/graftwatch/dump$"),
+         lambda m, q: obs.graftwatch.get().recorder.build(
+             reason="api")),
     ]
+
+
+def _graftwatch_series(q) -> dict:
+    sampler = obs.graftwatch.get().sampler
+    names = q.get("name")
+    if not names:
+        return {"names": sampler.names()}
+    slots, values = sampler.series(names[0])
+    return {"name": names[0],
+            "slots": [int(s) for s in slots],
+            "values": [None if v != v else float(v) for v in values]}
 
 
 def _make_handler(backend: ApiBackend):

@@ -7,6 +7,7 @@ instrumentation) is a TRUE no-op — no lock, no dict churn, no exception
 — so instrumented library code costs nothing on a bare interpreter."""
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -36,19 +37,37 @@ def _get(kind, name: str, help_: str, **kw):
         return m
 
 
+def _recorder():
+    """graftwatch's slot sampler, when loaded (obs.timeseries mirrors
+    every metric touch into its per-slot rings).  Same sys.modules
+    hand-off graftscope uses toward this module — no import cycle, and
+    a bare interpreter that never imported obs pays one dict probe."""
+    ts = sys.modules.get("lighthouse_tpu.obs.timeseries")
+    return None if ts is None else ts.record
+
+
 def inc_counter(name: str, help_: str = "", amount: float = 1) -> None:
+    rec = _recorder()
+    if rec is not None:
+        rec("counter", name, amount)
     if not _HAVE_PROM:
         return
     _get(Counter, name, help_ or name).inc(amount)
 
 
 def set_gauge(name: str, value: float, help_: str = "") -> None:
+    rec = _recorder()
+    if rec is not None:
+        rec("gauge", name, value)
     if not _HAVE_PROM:
         return
     _get(Gauge, name, help_ or name).set(value)
 
 
 def observe(name: str, value: float, help_: str = "") -> None:
+    rec = _recorder()
+    if rec is not None:
+        rec("hist", name, value)
     if not _HAVE_PROM:
         return
     _get(Histogram, name, help_ or name).observe(value)
@@ -59,7 +78,8 @@ def counter_value(name: str) -> float:
     prometheus is absent).  Scenario assertions read counters through
     this instead of scraping /metrics."""
     if not _HAVE_PROM:
-        return 0.0
+        ts = sys.modules.get("lighthouse_tpu.obs.timeseries")
+        return ts.get_sampler().counter_total(name) if ts else 0.0
     m = _metrics.get(name)
     if m is None:
         return 0.0
@@ -121,7 +141,7 @@ class timer:
         self._t0: float | None = None
 
     def __enter__(self):
-        if _HAVE_PROM:
+        if _HAVE_PROM or _recorder() is not None:
             self._t0 = time.perf_counter()
         return self
 

@@ -100,6 +100,11 @@ CATALOG: dict[str, tuple[str, str]] = {
     "op_pool_attestations": ("gauge", "Attestations pooled"),
     "op_pool_slashings": ("gauge", "Slashings pooled"),
     "op_pool_exits": ("gauge", "Voluntary exits pooled"),
+    # -- shared shuffling cache (state_transition/helpers.py, PR 5) ------
+    "shuffle_cache_hits_total":
+        ("counter", "Shared (seed, epoch) shuffling-cache hits"),
+    "shuffle_cache_misses_total":
+        ("counter", "Shared shuffling-cache misses (full re-shuffle)"),
     # -- store ------------------------------------------------------------
     "store_hot_db_ops_total": ("counter", "Hot DB operations"),
     "store_cold_db_ops_total": ("counter", "Freezer operations"),

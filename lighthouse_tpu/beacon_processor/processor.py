@@ -88,6 +88,9 @@ class BeaconProcessor:
         self.dropped = 0
         self.processed = 0
         self.high_water = 0     # max total pending ever seen (scenarios)
+        # graftwatch flight dumps include per-queue depths
+        from ..obs import graftwatch
+        graftwatch.register_processor(self)
 
     def start(self) -> None:
         self._manager.start()

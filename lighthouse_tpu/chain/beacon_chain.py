@@ -76,6 +76,9 @@ class BeaconChain:
         self.slot_clock = slot_clock
         # trace roots are slot-anchored against this clock (obs/)
         tracing.set_slot_clock(slot_clock)
+        # graftwatch samples the metric catalog + evaluates SLOs per slot
+        from ..obs import graftwatch
+        graftwatch.register_chain(self)
         self.execution_layer = execution_layer
         self.config = config or ChainConfig()
 
@@ -639,6 +642,8 @@ class BeaconChain:
                         int(self.fork_choice.finalized_checkpoint[0]))
                 M.gauge("beacon_justified_epoch",
                         int(self.fork_choice.justified_checkpoint[0]))
+                M.gauge("beacon_head_state_validators_total",
+                        len(head_state.validators))
                 if reorg:
                     M.count("beacon_reorgs_total")
                 self.events.emit("head", {
@@ -743,6 +748,11 @@ class BeaconChain:
         slot = self.slot()
         with self._lock:
             self.fork_choice.update_time(slot)
+        # graftwatch slot tick: sample the catalog, evaluate SLOs (the
+        # first node of an in-process network to reach this slot does
+        # the work; the facade dedupes the rest)
+        from ..obs import graftwatch
+        graftwatch.on_slot(slot)
         if self.monitor_pubkeys_pending:
             registry = self.head().head_state.validators
             still = []

@@ -39,6 +39,14 @@ def _count(name: str, amount: float = 1) -> None:
     if md is not None:
         md.count(name, amount)
 
+
+def _gauge(name: str, value: float) -> None:
+    """Catalog gauge, same sys.modules gating as _count."""
+    import sys
+    md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+    if md is not None:
+        md.gauge(name, value)
+
 MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
 MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
 
@@ -166,12 +174,21 @@ class GossipEngine:
             self._dontwant.pop(node_id, None)
             for members in self.mesh.values():
                 members.discard(node_id)
+        self._mesh_gauge()
+
+    def _mesh_gauge(self) -> None:
+        """Feed gossipsub_mesh_peers (total mesh size across topics)
+        after any mesh mutation; called outside self._lock."""
+        with self._lock:
+            total = sum(len(m) for m in self.mesh.values())
+        _gauge("gossipsub_mesh_peers", total)
 
     # -- subscriptions -------------------------------------------------------
 
     def subscribe(self, topic: str) -> None:
         self.subscriptions.add(topic)
         self.mesh.setdefault(topic, set())
+        self._mesh_gauge()
         rpc = pb.Rpc(subscriptions=[
             pb.SubOpts(True, full_topic(topic, self.fork_digest))])
         for peer in list(self.transport.peers.values()):
@@ -181,6 +198,7 @@ class GossipEngine:
         self.subscriptions.discard(topic)
         with self._lock:
             members = self.mesh.pop(topic, set())
+        self._mesh_gauge()
         ft = full_topic(topic, self.fork_digest)
         prune = pb.Rpc(control=pb.ControlMessage(
             prune=[pb.ControlPrune(ft)]))
@@ -352,6 +370,7 @@ class GossipEngine:
             return
         with self._lock:
             self.mesh.setdefault(topic, set()).add(peer.node_id)
+        self._mesh_gauge()
 
     def _handle_prune(self, peer, prune: pb.ControlPrune) -> None:
         topic = self._bare(peer, prune.topic)
@@ -361,6 +380,7 @@ class GossipEngine:
         with self._lock:
             self.mesh.get(topic, set()).discard(peer.node_id)
             self._backoff[(peer.node_id, topic)] = _now() + float(backoff)
+        self._mesh_gauge()
 
     def _handle_ihave(self, peer, ihave: pb.ControlIHave) -> None:
         topic = self._bare(peer, ihave.topic)
@@ -483,6 +503,7 @@ class GossipEngine:
                     dw.popitem(last=False)
                 if not dw:
                     del self._dontwant[pid]
+        self._mesh_gauge()
         for pid, topic in plans_graft:
             self._send_rpc_id(pid, pb.Rpc(control=pb.ControlMessage(
                 graft=[pb.ControlGraft(

@@ -1,9 +1,16 @@
 """Peer scoring + lifecycle (peer_manager/peerdb/score.rs equivalent)."""
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
+
+
+def _metrics():
+    """metrics_defs, sys.modules-gated (wire tests run the network layer
+    without the metrics stack)."""
+    return sys.modules.get("lighthouse_tpu.api.metrics_defs")
 
 
 @dataclass
@@ -34,11 +41,24 @@ class PeerManager:
 
     def on_connect(self, node_id: str) -> None:
         with self._lock:
+            new = node_id not in self.peers
             self.peers.setdefault(node_id, PeerInfo(node_id))
+            n = len(self.peers)
+        md = _metrics()
+        if md is not None:
+            if new:
+                md.count("libp2p_peer_connect_total")
+            md.gauge("libp2p_peers", n)
 
     def on_disconnect(self, node_id: str) -> None:
         with self._lock:
-            self.peers.pop(node_id, None)
+            gone = self.peers.pop(node_id, None)
+            n = len(self.peers)
+        md = _metrics()
+        if md is not None:
+            if gone is not None:
+                md.count("libp2p_peer_disconnect_total")
+            md.gauge("libp2p_peers", n)
 
     def set_status(self, node_id: str, status) -> None:
         with self._lock:

@@ -223,7 +223,8 @@ class SyncManager:
         self.ctx = _RealSyncContext(chain, rpc, peer_manager)
         self.range = RangeSync(self.ctx)
         self.lookups = BlockLookups(self.ctx)
-        self.state = "synced"          # synced | range_syncing
+        self.state = "synced"          # synced | range_syncing (property
+        #                                feeds the sync_state gauge)
         # one strategy drives at a time: the service loop, gossip handlers
         # and tests all enter through these methods (manager.rs: the sync
         # manager is a single task; here a lock provides the same
@@ -231,6 +232,18 @@ class SyncManager:
         # caller that waited on a concurrent sync still reports its
         # progress.
         self._drive_lock = threading.RLock()
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @state.setter
+    def state(self, value: str) -> None:
+        self._state = value
+        import sys
+        md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+        if md is not None:
+            md.gauge("sync_state", 0 if value == "synced" else 1)
 
     def stop(self) -> None:
         """Refuse new downloads and cancel queued ones; in-flight request

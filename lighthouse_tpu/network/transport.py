@@ -192,6 +192,12 @@ class Peer:
         except Exception:
             pass
         try:
+            # close() alone does not wake a recv() blocked in another
+            # thread; shutdown() delivers EOF to it first
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self.sock.close()
         except OSError:
             pass

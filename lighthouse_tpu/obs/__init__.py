@@ -1,8 +1,9 @@
-"""graftscope — structured tracing + JAX runtime accounting (L9).
+"""graftscope + graftwatch — tracing, accounting, SLOs, flight dumps (L9).
 
 See OBSERVABILITY.md for the span taxonomy, the ``/lighthouse/tracing``
-endpoint, the Perfetto export workflow and the compile/transfer
-counters.  Everything here is stdlib-only at import time.
+and ``/lighthouse/graftwatch/*`` endpoints, the Perfetto export
+workflow, the compile/transfer counters, and the graftwatch SLO table.
+Everything here is stdlib+numpy at import time.
 """
 from .jax_accounting import (
     account_transfer, host_readback, install_monitoring, snapshot as
@@ -11,15 +12,19 @@ from .jax_accounting import (
 from .capture import ScenarioTrace, scenario_capture
 from .report import render_table, summarize_chrome, summarize_spans
 from .tracing import (
-    SPAN_KINDS, Span, annotate, attach, capture, chrome_trace, clear,
-    current_context, current_span, set_slot_clock, snapshot, span,
+    SPAN_KINDS, Span, annotate, attach, capture, capture_scope,
+    chrome_trace, clear, current_context, current_span, set_slot_clock,
+    snapshot, span,
 )
+from . import flight, graftwatch, slo, timeseries
 
 __all__ = [
-    "SPAN_KINDS", "Span", "annotate", "attach", "capture", "chrome_trace",
+    "SPAN_KINDS", "Span", "annotate", "attach", "capture",
+    "capture_scope", "chrome_trace",
     "clear", "current_context", "current_span", "set_slot_clock",
     "snapshot", "span", "ScenarioTrace", "scenario_capture",
     "account_transfer", "host_readback",
     "install_monitoring", "jax_counters", "track_compiles",
     "render_table", "summarize_chrome", "summarize_spans",
+    "flight", "graftwatch", "slo", "timeseries",
 ]

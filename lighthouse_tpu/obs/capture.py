@@ -2,14 +2,16 @@
 
 The adversarial scenarios (testing/scenarios.py) assert on graftscope
 output — p95 pipeline latency, span counts, queue behavior — not just on
-end-state liveness.  ``scenario_capture()`` brackets a scenario run and
-hands back only the spans that STARTED inside the bracket, so envelopes
-are not polluted by setup traffic (genesis import, initial dials) that
-happened before the faults were armed.
+end-state liveness.  ``scenario_capture()`` brackets a scenario run in a
+:class:`tracing.capture_scope`, so envelopes see exactly the spans that
+belong to the bracket: setup traffic (genesis import, initial dials)
+started before the scope opened is excluded, and a *concurrent* capture
+(or explicitly-scoped background work) no longer bleeds in — spans are
+selected by scope membership, not by wall-clock overlap, which is what
+the old ``start >= t0`` filter got wrong.
 """
 from __future__ import annotations
 
-import time
 from contextlib import contextmanager
 
 from . import tracing
@@ -49,13 +51,16 @@ def scenario_capture():
         assert trace.p95_ms("block_pipeline") < 1500
 
     The global ring buffer is not cleared — other captures (and the
-    /lighthouse/tracing endpoint) keep seeing the same spans; filtering
-    is by span start time."""
-    t0 = time.perf_counter()
+    /lighthouse/tracing endpoint) keep seeing the same spans; selection
+    is by capture-scope membership (``tracing.capture_scope``), so
+    concurrent captures stay disjoint except for genuinely shared
+    infrastructure traffic, which every live capture sees."""
     trace = ScenarioTrace([])
-    try:
-        yield trace
-    finally:
-        spans = [s for s in tracing.snapshot() if s.start >= t0]
-        trace.spans = spans
-        trace.summary = summarize_spans(spans)
+    with tracing.capture_scope() as scope:
+        try:
+            yield trace
+        finally:
+            spans = [s for s in tracing.snapshot()
+                     if scope.id in s.scopes]
+            trace.spans = spans
+            trace.summary = summarize_spans(spans)

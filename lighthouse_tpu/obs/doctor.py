@@ -1,0 +1,202 @@
+"""graftwatch doctor — offline diagnosis of flight-recorder dumps.
+
+Library half of the doctor (``tools/obs/doctor.py`` is the CLI): load a
+versioned dump written by :mod:`obs.flight`, and for every incident in
+it correlate the breach with co-occurring signals from the bundled
+time-series window — runtime recompiles, device transfer bytes,
+processor shedding and queue depth, reorgs, block-import throughput.
+The diagnosis is deterministic over the dump content, so a checked-in
+fixture dump pins the report as a golden file.
+"""
+from __future__ import annotations
+
+import json
+
+from .flight import FORMAT_VERSION
+
+
+class DoctorError(Exception):
+    """Unreadable or unsupported dump."""
+
+    def __init__(self, message: str, exit_code: int = 2):
+        super().__init__(message)
+        self.exit_code = exit_code
+
+
+def load(path: str) -> dict:
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as exc:
+        raise DoctorError(f"cannot read dump {path!r}: {exc}") from exc
+    if not isinstance(doc, dict) or doc.get("format") != "graftwatch-dump":
+        raise DoctorError(f"{path!r} is not a graftwatch dump")
+    if doc.get("version") != FORMAT_VERSION:
+        raise DoctorError(
+            f"dump version {doc.get('version')!r} unsupported "
+            f"(doctor speaks {FORMAT_VERSION})", exit_code=3)
+    return doc
+
+
+def _window_indices(slots: list[int], opened: int,
+                    resolved: int | None, pre: int = 2,
+                    post: int = 1) -> list[int]:
+    """Ring rows inside [opened - pre, resolved + post] (open-ended when
+    unresolved)."""
+    lo = opened - pre
+    hi = None if resolved is None else resolved + post
+    return [i for i, s in enumerate(slots)
+            if s >= lo and (hi is None or s <= hi)]
+
+
+def _vals(series: dict, name: str, idx: list[int]) -> list[float]:
+    vals = series.get(name) or []
+    return [vals[i] for i in idx
+            if i < len(vals) and vals[i] is not None]
+
+
+def _stats(vals: list[float]) -> dict:
+    if not vals:
+        return {"n": 0}
+    return {"n": len(vals), "min": min(vals), "max": max(vals),
+            "sum": sum(vals)}
+
+
+#: (series name, kind) scanned for every incident; "delta" series sum
+#: activity over the window, "level" series report their peak
+_COSIGNALS = [
+    ("jax_compile_total", "delta", "runtime XLA recompiles"),
+    ("jax_compile_seconds_total", "delta", "XLA compile seconds"),
+    ("jax_transfer_host_to_device_bytes_total", "delta",
+     "host->device transfer bytes"),
+    ("jax_transfer_device_to_host_bytes_total", "delta",
+     "device->host transfer bytes"),
+    ("beacon_processor_work_dropped_total", "delta",
+     "processor work items shed"),
+    ("beacon_processor_queue_length", "level",
+     "processor queue depth"),
+    ("beacon_reorgs_total", "delta", "head reorgs"),
+    ("beacon_block_imported_total", "delta", "blocks imported"),
+    ("gossipsub_validation_reject_total", "delta",
+     "gossip messages rejected"),
+]
+
+
+def _correlate_incident(inc: dict, slots: list[int],
+                        series: dict) -> dict:
+    idx = _window_indices(slots, int(inc["opened_slot"]),
+                          inc.get("resolved_slot"))
+    win_slots = [slots[i] for i in idx]
+    out = {
+        "slo": inc["slo"],
+        "opened_slot": inc["opened_slot"],
+        "resolved_slot": inc.get("resolved_slot"),
+        "worst_value": inc.get("worst_value"),
+        "budget": inc.get("budget"),
+        "detail": inc.get("detail", ""),
+        "window_slots": [min(win_slots), max(win_slots)]
+        if win_slots else None,
+        "correlations": [],
+    }
+    # the breached metric's own trajectory always leads the diagnosis —
+    # a correlated report is never empty for a well-formed dump
+    metric = inc.get("metric", "")
+    own_names = [n for n in (metric, metric + ".p95", metric + ".count")
+                 if n in series]
+    for name in own_names or [metric]:
+        st = _stats(_vals(series, name, idx))
+        out["correlations"].append({
+            "signal": name, "kind": "breached_metric", "stats": st,
+            "note": "trajectory of the metric the SLO watches"})
+    for name, kind, label in _COSIGNALS:
+        vals = _vals(series, name, idx)
+        st = _stats(vals)
+        if st["n"] == 0:
+            continue
+        active = (st["sum"] > 0) if kind == "delta" else (st["max"] > 0)
+        if not active:
+            continue
+        out["correlations"].append({
+            "signal": name, "kind": kind, "stats": st, "note": label})
+    return out
+
+
+def diagnose(doc: dict) -> dict:
+    """Correlated diagnosis over every incident in a loaded dump."""
+    ts = doc.get("timeseries") or {}
+    slots = ts.get("slots") or []
+    series = ts.get("series") or {}
+    incidents = doc.get("incidents") or []
+    spans = (doc.get("chrome_trace") or {}).get("traceEvents") or []
+    return {
+        "reason": doc.get("reason"),
+        "slot": doc.get("slot"),
+        "version": doc.get("version"),
+        "window_slots": len(slots),
+        "span_events": len(spans),
+        "jax": doc.get("jax") or {},
+        "chains": doc.get("chains") or [],
+        "processors": doc.get("processors") or [],
+        "incidents": [_correlate_incident(i, slots, series)
+                      for i in incidents],
+    }
+
+
+def _fmt_num(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and not v.is_integer():
+        return f"{v:.4g}"
+    return str(int(v))
+
+
+def render(diag: dict) -> str:
+    lines = [
+        f"graftwatch doctor — dump v{diag['version']} "
+        f"(reason {diag['reason']}, slot {_fmt_num(diag['slot'])}, "
+        f"{diag['window_slots']} slots of series, "
+        f"{diag['span_events']} span events)",
+    ]
+    jax = diag.get("jax") or {}
+    if jax:
+        lines.append(
+            "  jax: "
+            f"{_fmt_num(jax.get('compiles'))} compiles, "
+            f"{_fmt_num(jax.get('h2d_bytes'))} B h2d, "
+            f"{_fmt_num(jax.get('d2h_bytes'))} B d2h")
+    for ch in diag.get("chains") or []:
+        if "error" in ch:
+            lines.append(f"  chain: <{ch['error']}>")
+        else:
+            lines.append(
+                f"  chain: head slot {_fmt_num(ch.get('head_slot'))} "
+                f"@ clock {_fmt_num(ch.get('clock_slot'))}, "
+                f"finalized epoch {_fmt_num(ch.get('finalized_epoch'))}, "
+                f"{_fmt_num(ch.get('proto_nodes'))} proto nodes")
+    for pr in diag.get("processors") or []:
+        if "error" not in pr:
+            lines.append(
+                f"  processor: {_fmt_num(pr.get('processed'))} processed, "
+                f"{_fmt_num(pr.get('dropped'))} dropped, "
+                f"high water {_fmt_num(pr.get('high_water'))}")
+    if not diag["incidents"]:
+        lines.append("no incidents in dump")
+    for inc in diag["incidents"]:
+        res = inc["resolved_slot"]
+        lines.append(
+            f"incident {inc['slo']}: opened slot "
+            f"{_fmt_num(inc['opened_slot'])}, "
+            + ("OPEN" if res is None else f"resolved slot {_fmt_num(res)}")
+            + f", worst {_fmt_num(inc['worst_value'])} "
+              f"(budget {_fmt_num(inc['budget'])}) — {inc['detail']}")
+        for c in inc["correlations"]:
+            st = c["stats"]
+            if st.get("n"):
+                stat_s = (f"n={st['n']} min={_fmt_num(st['min'])} "
+                          f"max={_fmt_num(st['max'])} "
+                          f"sum={_fmt_num(st['sum'])}")
+            else:
+                stat_s = "no samples in window"
+            lines.append(f"  - {c['signal']} [{c['kind']}]: {stat_s}"
+                         f" — {c['note']}")
+    return "\n".join(lines)

@@ -77,7 +77,7 @@ class Span:
     """One finished (or in-flight) timed region."""
 
     __slots__ = ("trace_id", "span_id", "parent_id", "kind", "start",
-                 "end", "thread_id", "thread_name", "attrs")
+                 "end", "thread_id", "thread_name", "attrs", "scopes")
 
     def __init__(self, trace_id: str, span_id: str, parent_id: str | None,
                  kind: str):
@@ -91,6 +91,8 @@ class Span:
         self.thread_id = t.ident or 0
         self.thread_name = t.name
         self.attrs: dict = {}
+        #: capture-scope ids this span belongs to (see capture_scope)
+        self.scopes: frozenset = frozenset()
 
     @property
     def duration(self) -> float:
@@ -145,12 +147,64 @@ class _Ctx(threading.local):
         self.stack: list[Span] = []
         #: (trace_id, span_id) adopted from another thread via attach()
         self.inherited: tuple[str, str] | None = None
+        #: capture scopes explicitly bound to this thread (propagated by
+        #: capture()/attach); None = unscoped thread, whose *root* spans
+        #: adopt every globally active scope (see capture_scope)
+        self.scopes: frozenset | None = None
 
 
 _ctx = _Ctx()
 _ids = itertools.count(1)
 _ring = SpanRing()
 _slot_clock = None
+
+# -- capture scopes ----------------------------------------------------------
+# A capture scope tags spans so concurrent captures (and background
+# traffic outside any capture) can be told apart when reading the shared
+# ring.  Scope membership propagates two ways:
+#  - explicitly: capture()/attach hand a thread's scope set across
+#    spawns and work-queue hops together with the trace context;
+#  - implicitly: a root span on a thread with NO explicit scope set
+#    (e.g. a transport read-loop spawned at connection time, long before
+#    any capture existed) is tagged with every scope active at that
+#    moment — such traffic cannot be attributed to one capture, so every
+#    live capture sees it rather than none (the envelopes assert on
+#    pipeline spans that are born exactly there).
+_scope_ids = itertools.count(1)
+_active_scopes: set[int] = set()
+_scopes_lock = threading.Lock()
+
+
+def _active_scope_snapshot() -> frozenset:
+    if not _active_scopes:          # fast path; benign race
+        return frozenset()
+    with _scopes_lock:
+        return frozenset(_active_scopes)
+
+
+class capture_scope:
+    """Context manager opening one capture scope: spans started while
+    it is active (per the propagation rules above) carry ``self.id`` in
+    ``Span.scopes``.  Nests: a thread inside two scopes tags both."""
+
+    def __init__(self):
+        self.id: int | None = None
+        self._prev: frozenset | None = None
+
+    def __enter__(self) -> "capture_scope":
+        self.id = next(_scope_ids)
+        with _scopes_lock:
+            _active_scopes.add(self.id)
+        self._prev = _ctx.scopes
+        base = self._prev if self._prev is not None else frozenset()
+        _ctx.scopes = base | {self.id}
+        return self
+
+    def __exit__(self, *exc):
+        with _scopes_lock:
+            _active_scopes.discard(self.id)
+        _ctx.scopes = self._prev
+        return False
 
 
 def set_slot_clock(clock) -> None:
@@ -177,10 +231,23 @@ def current_context() -> tuple[str, str] | None:
     return _ctx.inherited
 
 
-def capture() -> tuple[str, str] | None:
+def capture() -> tuple | None:
     """Snapshot the calling thread's context for explicit hand-off to
-    another thread / work queue (pair with :class:`attach`)."""
-    return current_context()
+    another thread / work queue (pair with :class:`attach`).
+
+    Returns ``(trace_id, span_id, scopes)`` — the scope element rides
+    along so work queued from inside a capture window stays attributed
+    to it when a worker thread executes later.  ``attach`` also still
+    accepts the historical 2-tuple shape."""
+    s = current_span()
+    if s is not None:
+        return (s.trace_id, s.span_id, s.scopes)
+    scopes = _ctx.scopes
+    if _ctx.inherited is not None:
+        return _ctx.inherited + (scopes,)
+    if scopes is not None:
+        return (None, None, scopes)
+    return None
 
 
 def annotate(**kw) -> None:
@@ -198,18 +265,28 @@ class attach:
             with tracing.span(...): ...  # joins the submitter's trace
     """
 
-    def __init__(self, ctx: tuple[str, str] | None):
-        self.ctx = tuple(ctx) if ctx is not None else None
+    def __init__(self, ctx: tuple | None):
+        ctx = tuple(ctx) if ctx is not None else None
+        self.scopes: frozenset | None = None
+        if ctx is not None and len(ctx) == 3:
+            self.scopes = ctx[2]
+            ctx = None if ctx[0] is None else ctx[:2]
+        self.ctx = ctx
         self._prev: tuple[str, str] | None = None
+        self._prev_scopes: frozenset | None = None
 
     def __enter__(self):
         self._prev = _ctx.inherited
+        self._prev_scopes = _ctx.scopes
         if self.ctx is not None:
             _ctx.inherited = self.ctx
+        if self.scopes is not None:
+            _ctx.scopes = self.scopes
         return self
 
     def __exit__(self, *exc):
         _ctx.inherited = self._prev
+        _ctx.scopes = self._prev_scopes
         return False
 
 
@@ -238,11 +315,16 @@ class span:
         parent = current_span()
         if parent is not None:
             trace_id, parent_id = parent.trace_id, parent.span_id
-        elif _ctx.inherited is not None:
-            trace_id, parent_id = _ctx.inherited
+            scopes = parent.scopes
         else:
-            trace_id, parent_id = _new_id(), None
+            if _ctx.inherited is not None:
+                trace_id, parent_id = _ctx.inherited
+            else:
+                trace_id, parent_id = _new_id(), None
+            scopes = (_ctx.scopes if _ctx.scopes is not None
+                      else _active_scope_snapshot())
         s = Span(trace_id, _new_id(), parent_id, self.kind)
+        s.scopes = scopes
         s.attrs.update(self._attrs)
         if parent_id is None and _slot_clock is not None:
             # slot-anchored root: how late into the slot did this start?

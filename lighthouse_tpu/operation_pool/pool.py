@@ -39,30 +39,49 @@ class OperationPool:
         cb = getattr(attestation, "committee_bits", None)
         key = data_root + (bytes(int(b) for b in cb) if cb is not None
                            else bytes([attestation.data.index & 0xFF]))
+        try:
+            with self._lock:
+                self._att_data[data_root] = attestation.data
+                bucket = self._attestations[key]
+                new_bits = tuple(attestation.aggregation_bits)
+                for i, existing in enumerate(bucket):
+                    ex_bits = tuple(existing.aggregation_bits)
+                    if all(not b or e for b, e in zip(new_bits, ex_bits)):
+                        return  # subset of existing
+                    if all(not e or b for b, e in zip(new_bits, ex_bits)):
+                        bucket[i] = attestation  # superset replaces
+                        return
+                    if not any(b and e for b, e in zip(new_bits, ex_bits)):
+                        # disjoint: aggregate signatures
+                        merged_bits = [b or e
+                                       for b, e in zip(new_bits, ex_bits)]
+                        agg = bls.aggregate_signatures(
+                            [existing.signature, attestation.signature])
+                        merged = type(attestation)(
+                            aggregation_bits=merged_bits,
+                            data=attestation.data, signature=agg,
+                            **({"committee_bits": attestation.committee_bits}
+                               if hasattr(attestation, "committee_bits")
+                               else {}))
+                        bucket[i] = merged
+                        return
+                bucket.append(attestation)
+        finally:
+            self._feed_gauges()
+
+    def _feed_gauges(self) -> None:
+        """Feed the op_pool_* gauges after any mutation."""
         with self._lock:
-            self._att_data[data_root] = attestation.data
-            bucket = self._attestations[key]
-            new_bits = tuple(attestation.aggregation_bits)
-            for i, existing in enumerate(bucket):
-                ex_bits = tuple(existing.aggregation_bits)
-                if all(not b or e for b, e in zip(new_bits, ex_bits)):
-                    return  # subset of existing
-                if all(not e or b for b, e in zip(new_bits, ex_bits)):
-                    bucket[i] = attestation  # superset replaces
-                    return
-                if not any(b and e for b, e in zip(new_bits, ex_bits)):
-                    # disjoint: aggregate signatures
-                    merged_bits = [b or e for b, e in zip(new_bits, ex_bits)]
-                    agg = bls.aggregate_signatures(
-                        [existing.signature, attestation.signature])
-                    merged = type(attestation)(
-                        aggregation_bits=merged_bits,
-                        data=attestation.data, signature=agg,
-                        **({"committee_bits": attestation.committee_bits}
-                           if hasattr(attestation, "committee_bits") else {}))
-                    bucket[i] = merged
-                    return
-            bucket.append(attestation)
+            atts = sum(len(v) for v in self._attestations.values())
+            slashings = (len(self._proposer_slashings)
+                         + len(self._attester_slashings))
+            exits = len(self._voluntary_exits)
+        import sys
+        md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+        if md is not None:
+            md.gauge("op_pool_attestations", atts)
+            md.gauge("op_pool_slashings", slashings)
+            md.gauge("op_pool_exits", exits)
 
     def num_attestations(self) -> int:
         with self._lock:
@@ -148,14 +167,17 @@ class OperationPool:
         with self._lock:
             self._proposer_slashings[
                 slashing.signed_header_1.message.proposer_index] = slashing
+        self._feed_gauges()
 
     def insert_attester_slashing(self, slashing) -> None:
         with self._lock:
             self._attester_slashings.append(slashing)
+        self._feed_gauges()
 
     def insert_voluntary_exit(self, exit_) -> None:
         with self._lock:
             self._voluntary_exits[exit_.message.validator_index] = exit_
+        self._feed_gauges()
 
     def insert_bls_to_execution_change(self, change) -> None:
         with self._lock:
@@ -226,3 +248,4 @@ class OperationPool:
                 if any(is_slashable_validator(state, int(i), epoch)
                        for i in set(s.attestation_1.attesting_indices)
                        & set(s.attestation_2.attesting_indices))]
+        self._feed_gauges()

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import hashlib
 import math
+import sys
 import threading
 from collections import OrderedDict
 
@@ -193,7 +194,14 @@ class _SharedShufflingCache:
                 self.hits += 1
             else:
                 self.misses += 1
-            return cc
+        # feed outside the lock, through sys.modules so the STF library
+        # never imports the api package (tracing._observe_metric idiom);
+        # graftwatch's shuffle_cache_hit_ratio SLO reads these
+        md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+        if md is not None:
+            md.count("shuffle_cache_hits_total" if cc is not None
+                     else "shuffle_cache_misses_total")
+        return cc
 
     def insert(self, key: tuple, cc: CommitteeCache) -> None:
         with self._lock:

@@ -3,9 +3,10 @@
 ISSUE 7 tentpole, ROADMAP item 4: each scenario drives production nodes
 through a mainnet incident shape — long non-finality, partition + heal,
 slashable equivocation, checkpoint sync into a partitioned network, an
-invalid-signature gossip flood — and asserts a DEGRADATION ENVELOPE from
-graftscope trace output (p95 pipeline latency, head-lag vs the slot
-clock, processor queue behavior) alongside the correctness outcome.
+invalid-signature gossip flood — and asserts a DEGRADATION ENVELOPE
+evaluated by the graftwatch SLO engine (pipeline-p95 and head-lag
+objectives over the slot-sampled rings, plus the scoped graftscope
+capture) alongside the correctness outcome.
 "Didn't crash and eventually agreed" is not a pass; "stayed inside the
 envelope while degraded and recovered the invariants afterwards" is.
 
@@ -20,11 +21,15 @@ List:       python -m lighthouse_tpu.testing.simulator --scenario list
 from __future__ import annotations
 
 import random
+import shutil
+import tempfile
 import time
 from dataclasses import dataclass, field
 
 from ..api.metrics import counter_value
 from ..network.faults import FaultInjector
+from ..obs import doctor as flight_doctor
+from ..obs import graftwatch
 from ..obs.capture import ScenarioTrace, scenario_capture
 from ..specs import minimal_spec
 from ..validator_client.byzantine import ByzantineValidatorClient
@@ -43,6 +48,8 @@ class ScenarioResult:
     seed: int
     checks: list[CheckResult] = field(default_factory=list)
     trace: ScenarioTrace | None = None
+    dump_path: str | None = None        # flight dump, if one was written
+    diagnosis: str | None = None        # rendered doctor report over it
 
     @property
     def ok(self) -> bool:
@@ -92,18 +99,27 @@ def _chk(result: ScenarioResult, name: str, ok: bool, detail: str) -> bool:
 
 def _envelope_checks(result: ScenarioResult, net: LocalNetwork,
                      trace: ScenarioTrace, max_head_lag: int = 1) -> None:
-    """The graftscope-derived degradation envelope every scenario ends
-    on: blocks kept flowing through the pipeline, p95 stayed sane, and
-    the head tracked the slot clock."""
+    """The degradation envelope every scenario ends on, evaluated by the
+    graftwatch SLO engine — the same objectives a live node watches each
+    slot: blocks kept flowing through the pipeline, the pipeline-p95
+    objective never breached, and the head-lag objective is clean (any
+    mid-scenario incident resolved) by scenario end."""
     _chk(result, "pipeline_active", trace.count("block_pipeline") > 0,
          f"{trace.count('block_pipeline')} gossip block pipelines traced")
+    status = graftwatch.get().engine.status()
     p95 = trace.p95_ms("block_pipeline")
-    _chk(result, "pipeline_p95", p95 < PIPELINE_P95_MS,
-         f"p95 {p95:.1f}ms < {PIPELINE_P95_MS:.0f}ms")
+    pipe = status["block_pipeline_p95"]
+    _chk(result, "pipeline_p95",
+         pipe["open_incident"] is None and p95 < PIPELINE_P95_MS,
+         f"SLO clean ({pipe['last_detail']}); capture p95 {p95:.1f}ms "
+         f"< {PIPELINE_P95_MS:.0f}ms")
     chain = net.live_nodes[0].harness.chain
     lag = chain.slot() - chain.head().head_state.slot
-    _chk(result, "head_lag", lag <= max_head_lag,
-         f"head lags clock by {lag} slots (max {max_head_lag})")
+    head = status["head_lag"]
+    _chk(result, "head_lag",
+         head["open_incident"] is None and lag <= max_head_lag,
+         f"SLO clean ({head['last_detail']}); live lag {lag} slots "
+         f"(max {max_head_lag})")
 
 
 def _chain_blocks(chain, max_back: int = 128):
@@ -294,6 +310,12 @@ def scenario_signature_flood(seed: int = 0) -> ScenarioResult:
         _chk(result, "load_shed", dropped > 0 and proc.dropped > 0,
              f"{dropped:.0f} work items shed at the cap "
              f"(processor.dropped={proc.dropped})")
+        shed_incs = graftwatch.get().engine.incidents_for(
+            "processor_shedding")
+        _chk(result, "slo_shedding_incident", len(shed_incs) > 0,
+             f"flood tripped the processor_shedding SLO "
+             f"{len(shed_incs)} time(s), first at slot "
+             f"{shed_incs[0].opened_slot if shed_incs else '-'}")
         _chk(result, "queue_high_water", proc.high_water >= CAP,
              f"queue high-water {proc.high_water} >= cap {CAP}")
         flooder_score = victim.network.peers.score(
@@ -324,8 +346,16 @@ def scenario_partition_heal(seed: int = 0) -> ScenarioResult:
     spe = spec.preset.slots_per_epoch
     injector = FaultInjector(seed)
     net = LocalNetwork(spec, 4, 32, topology="mesh", injector=injector)
+    # the partition must surface through graftwatch, not just the
+    # hand-rolled fork checks: auto-dump a flight recording the moment
+    # an incident opens, assert the head-lag incident lifecycle, and
+    # round-trip the dump through the offline doctor
+    watch = graftwatch.get()
+    dump_dir = tempfile.mkdtemp(prefix="graftwatch_scn_")
+    watch.configure(auto_dump=True, dump_dir=dump_dir)
     try:
         net.run_slots(spe)                   # healthy baseline
+        part_start = int(net.nodes[0].harness.chain.slot())
         net.partition([0, 1], [2, 3])
         partition_slots = 2 * spe
         with scenario_capture() as trace:
@@ -366,8 +396,40 @@ def scenario_partition_heal(seed: int = 0) -> ScenarioResult:
              0 < depth <= partition_slots,
              f"re-org depth {depth} slots (fork at {fork_slot}, "
              f"partition lasted {partition_slots})")
-        _envelope_checks(result, net, trace)
+        # SLO-engine view of the same event: the partition opened a
+        # head-lag incident, and the heal let every incident resolve
+        incs = [i for i in watch.engine.incidents_for("head_lag")
+                if i.opened_slot > part_start]
+        _chk(result, "slo_incident_opened", len(incs) > 0,
+             f"head-lag incidents opened at slots "
+             f"{[i.opened_slot for i in incs]} "
+             f"(partition began after slot {part_start})")
+        _chk(result, "slo_incident_resolved",
+             bool(incs) and all(not i.open for i in incs)
+             and not watch.engine.open_incidents(),
+             f"resolved at slots {[i.resolved_slot for i in incs]}; "
+             "no incident still open after heal")
+        # incident-open wrote a flight dump; the offline doctor must
+        # turn it into a non-empty correlated diagnosis
+        result.dump_path = watch.recorder.last_path
+        dumped = result.dump_path is not None
+        _chk(result, "flight_dump_written", dumped,
+             f"auto-dump wrote {result.dump_path}")
+        if dumped:
+            diag = flight_doctor.diagnose(
+                flight_doctor.load(result.dump_path))
+            result.diagnosis = flight_doctor.render(diag)
+            lag_diags = [d for d in diag["incidents"]
+                         if d["slo"] == "head_lag" and d["correlations"]]
+            _chk(result, "doctor_diagnosis", len(lag_diags) > 0,
+                 f"doctor correlated {len(lag_diags)} head-lag "
+                 f"incident(s) with "
+                 f"{sum(len(d['correlations']) for d in lag_diags)} "
+                 "co-occurring signals")
     finally:
+        watch.configure(auto_dump=False)
+        watch.recorder.dump_dir = None
+        shutil.rmtree(dump_dir, ignore_errors=True)
         net.stop()
     return result
 

@@ -7,8 +7,32 @@ from __future__ import annotations
 
 import os
 import resource
+import time
 
 from ..api import metrics_defs
+
+#: (wall seconds, cpu seconds) at the previous snapshot; CPU percent is
+#: the utime+stime delta over the wall delta between snapshots
+_cpu_mark: tuple[float, float] | None = None
+
+
+def _cpu_seconds() -> float:
+    """Process CPU time (utime+stime, self) from getrusage."""
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return ru.ru_utime + ru.ru_stime
+
+
+def _cpu_percent() -> float:
+    global _cpu_mark
+    now = time.monotonic()
+    cpu = _cpu_seconds()
+    mark, _cpu_mark = _cpu_mark, (now, cpu)
+    if mark is None:
+        return 0.0
+    wall_d = now - mark[0]
+    if wall_d <= 0:
+        return 0.0
+    return max(0.0, 100.0 * (cpu - mark[1]) / wall_d)
 
 
 def _meminfo() -> dict[str, int]:
@@ -51,4 +75,5 @@ def snapshot(data_dir: str = "/") -> dict:
     metrics_defs.gauge("system_load_1m", la1)
     metrics_defs.gauge("process_resident_memory_bytes", rss)
     metrics_defs.gauge("system_disk_free_bytes", disk_free)
+    metrics_defs.gauge("process_cpu_percent", _cpu_percent())
     return out

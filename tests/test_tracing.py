@@ -270,6 +270,32 @@ def test_every_catalog_histogram_is_fed_or_external():
     assert not unfed, f"declared but never fed: {unfed}"
 
 
+def test_every_catalog_gauge_is_fed_or_external():
+    """PR-9 satellite: the same completeness gate for gauges.  A gauge
+    feeding site is an actual ``gauge("name", ...)`` / ``set_gauge(...)``
+    call (regex, not a bare name mention — graftwatch's SLO registry and
+    the doctor reference gauge *names* without feeding them), outside
+    metrics_defs/metrics themselves; EXTERNALLY_FED is honored."""
+    import re as _re
+    sources = {}
+    for f in SRC_FILES:
+        sources[str(f)] = f.read_text()
+    unfed = []
+    for name, (kind, _help) in metrics_defs.CATALOG.items():
+        if kind != "gauge":
+            continue
+        if name in metrics_defs.EXTERNALLY_FED:
+            continue
+        pat = _re.compile(
+            r"(?:gauge|set_gauge)\(\s*\n?\s*[\"']" + _re.escape(name))
+        if any(pat.search(text) for path, text in sources.items()
+               if not path.endswith("api/metrics_defs.py")
+               and not path.endswith("api/metrics.py")):
+            continue
+        unfed.append(name)
+    assert not unfed, f"gauges declared but never set: {unfed}"
+
+
 def test_externally_fed_entries_are_justified_and_declared():
     for name, why in metrics_defs.EXTERNALLY_FED.items():
         assert name in metrics_defs.CATALOG
@@ -384,7 +410,11 @@ def test_bls_factory_shape_change_increments_compile_counter():
 
 def test_metrics_are_true_noops_without_prometheus(monkeypatch):
     """Satellite: with prometheus_client absent the whole catalog must
-    import and run as a no-op — no exceptions, no registry dict churn."""
+    import and run without touching the registry.  Since graftwatch,
+    the helpers still mirror into obs.timeseries when it is loaded —
+    the TRUE-no-op guarantee (never read the clock, zero dict churn)
+    holds for a bare interpreter with NEITHER prometheus NOR the
+    graftwatch sampler, i.e. pure crypto/ssz library users."""
     monkeypatch.setitem(sys.modules, "prometheus_client", None)
     importlib.reload(metrics)
     try:
@@ -402,6 +432,14 @@ def test_metrics_are_true_noops_without_prometheus(monkeypatch):
                 metrics_defs.observe(name, 0.01)
                 with metrics_defs.timed(name):
                     pass
+        # graftwatch loaded -> timers DO read the clock (the sampler
+        # needs durations even on a prometheus-free node)
+        t = metrics.start_timer("beacon_block_processing_seconds")
+        assert t._t0 is not None
+        t.stop()
+        # bare interpreter: hide the sampler too -> true no-op
+        monkeypatch.setitem(sys.modules,
+                            "lighthouse_tpu.obs.timeseries", None)
         t = metrics.start_timer("beacon_block_processing_seconds")
         assert t._t0 is None                 # never read the clock
         t.observe_duration()

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""graftwatch doctor — offline diagnosis of a flight-recorder dump.
+
+    python tools/obs/doctor.py graftwatch_24_001_incident_head_lag.json
+    python tools/obs/doctor.py --json dump.json      # machine-readable
+
+Loads a versioned dump written by the flight recorder (auto-dump on
+incident-open, /lighthouse/graftwatch/dump, or SIGUSR2) and correlates
+every SLO breach in it with the co-occurring signals bundled alongside:
+runtime XLA recompiles, device transfer bytes, processor shedding and
+queue depth, reorgs, block-import throughput.  The breached metric's own
+trajectory always leads each incident's diagnosis.
+
+Exit codes: 0 report produced, 2 unreadable/invalid dump, 3 dump format
+version unsupported.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+from lighthouse_tpu.obs import doctor  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="flight-recorder dump file")
+    ap.add_argument("--json", action="store_true",
+                    help="print the diagnosis as JSON instead of text")
+    args = ap.parse_args(argv)
+    try:
+        doc = doctor.load(args.path)
+    except doctor.DoctorError as e:
+        print(str(e), file=sys.stderr)
+        return e.exit_code
+    diag = doctor.diagnose(doc)
+    print(json.dumps(diag, indent=2) if args.json
+          else doctor.render(diag))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
