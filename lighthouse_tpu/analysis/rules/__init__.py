@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     shutdown_order,
     spec_constants,
     ssz_schema,
+    store_atomicity,
     thread_lifecycle,
     trace_safety,
 )

@@ -113,6 +113,13 @@ CATALOG: dict[str, tuple[str, str]] = {
         ("hist", "Cold-state reconstruction latency"),
     "store_state_cache_hits_total": ("counter", "State-cache hits"),
     "store_state_cache_misses_total": ("counter", "State-cache misses"),
+    "store_batch_commit_total":
+        ("counter", "Atomic StoreOp batches committed (one CRC'd log "
+                    "record each)"),
+    "store_recovery_repairs_total":
+        ("counter", "Repairs applied by resume_chain's recovery ladder"),
+    "store_fsck_errors_total":
+        ("counter", "Consistency errors reported by store fsck"),
     # -- crypto hot spots -------------------------------------------------
     "bls_batch_verify_sigs": ("hist", "Signatures per device batch"),
     "bls_device_pairing_seconds": ("hist", "Device pairing-check latency"),

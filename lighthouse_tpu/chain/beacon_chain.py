@@ -37,7 +37,8 @@ from ..state_transition.helpers import (
     get_beacon_proposer_index, get_indexed_attestation,
     latest_block_header_root,
 )
-from ..store import HotColdDB
+from ..store import HotColdDB, StoreOp
+from ..utils.crashpoints import crashpoint
 from ..utils.slot_clock import SlotClock
 from . import attestation_verification as att_verify
 from . import block_verification as blk_verify
@@ -179,15 +180,14 @@ class BeaconChain:
         self._monitored_epoch = 0
         self.eth1_service = None       # optional Eth1Service
 
-        store.store_genesis(self.genesis_block_root, genesis_state)
-        if genesis_block is not None:
-            store.put_block(self.genesis_block_root, genesis_block)
-            if genesis_state.slot > 0:
-                # checkpoint-sync anchor: history before this block is
-                # backfilled by SyncManager.backfill
-                store.set_backfill_anchor(
-                    genesis_block.message.slot,
-                    genesis_block.message.parent_root)
+        store.store_genesis(self.genesis_block_root, genesis_state,
+                            genesis_block)
+        if genesis_block is not None and genesis_state.slot > 0:
+            # checkpoint-sync anchor: history before this block is
+            # backfilled by SyncManager.backfill
+            store.set_backfill_anchor(
+                genesis_block.message.slot,
+                genesis_block.message.parent_root)
 
     # -- time / status -------------------------------------------------------
 
@@ -501,8 +501,15 @@ class BeaconChain:
                     self._monitored_epoch - 1, state)
             self.validator_monitor.note_state(state)
             with tracing.span("db_write"):
-                self.store.put_block(block_root, ep.signed_block)
-                self.store.put_state(block.state_root, state)
+                # block + state land as ONE log record: a crash at either
+                # side of the batch leaves the store before-or-after, never
+                # a block whose post-state is missing
+                crashpoint("block_import:before_batch")
+                self.store.do_atomically(
+                    [StoreOp.put_block(block_root, ep.signed_block),
+                     StoreOp.put_state(block.state_root, state)],
+                    fsync=False)
+                crashpoint("block_import:after_state_write")
                 self._cache_snapshot(block_root, state)
             try:
                 # serve attestations for this block state-free from now on

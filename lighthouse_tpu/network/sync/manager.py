@@ -100,7 +100,12 @@ class _RealSyncContext:
         self.chain.store.set_backfill_anchor(slot, root)
 
     def store_backfill_block(self, root: bytes, sb) -> None:
-        self.chain.store.put_block(root, sb)
+        from ...store import StoreOp
+        # hot block first, freezer root second: a crash between the two
+        # leaves a re-downloadable gap, never a freezer root pointing at
+        # a block the store doesn't have
+        self.chain.store.do_atomically([StoreOp.put_block(root, sb)],
+                                       fsync=False)
         self.chain.store.freezer_put_block_root(sb.message.slot, root)
 
     # -- request IO ----------------------------------------------------------

@@ -137,6 +137,7 @@ def diagnose(doc: dict) -> dict:
         "jax": doc.get("jax") or {},
         "chains": doc.get("chains") or [],
         "processors": doc.get("processors") or [],
+        "recovery": doc.get("recovery"),
         "incidents": [_correlate_incident(i, slots, series)
                       for i in incidents],
     }
@@ -179,6 +180,24 @@ def render(diag: dict) -> str:
                 f"  processor: {_fmt_num(pr.get('processed'))} processed, "
                 f"{_fmt_num(pr.get('dropped'))} dropped, "
                 f"high water {_fmt_num(pr.get('high_water'))}")
+    rec = diag.get("recovery")
+    if rec:
+        repairs = rec.get("repairs") or []
+        lines.append(
+            "  recovery: restored="
+            + ("yes" if rec.get("restored") else "no")
+            + (", fork choice REBUILT" if rec.get("fork_choice_rebuilt")
+               else "")
+            + f", seq {_fmt_num(rec.get('seq'))}, "
+            f"{len(repairs)} repair(s), "
+            f"{_fmt_num(rec.get('op_pool_skipped'))} op-pool entries "
+            f"skipped")
+        for r in repairs:
+            lines.append(f"    repaired: {r}")
+        if repairs:
+            lines.append(
+                "    note: incidents shortly after the dump's restart "
+                "slot may trace back to the repaired state above")
     if not diag["incidents"]:
         lines.append("no incidents in dump")
     for inc in diag["incidents"]:

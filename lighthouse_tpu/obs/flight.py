@@ -15,6 +15,8 @@ offline:
 - a fork-choice head summary per registered chain
 - the trace-stamped ``log_buffer`` tail
 - every incident (open and resolved) plus current SLO status
+- the last store-recovery report (``chain.persistence.LAST_RECOVERY``),
+  so post-restart incidents can be read against what boot repaired
 
 Writes are tmp-file + ``os.replace`` so a reader never sees a torn
 dump.  ``FORMAT_VERSION`` gates the doctor's parser.
@@ -25,6 +27,7 @@ import json
 import math
 import os
 import signal
+import sys
 import tempfile
 import threading
 
@@ -50,6 +53,20 @@ def _json_safe(obj):
     if isinstance(obj, (str, int, bool)) or obj is None:
         return obj
     return repr(obj)
+
+
+def _recovery_report():
+    """Last `resume_chain` report, when the process ever resumed.
+
+    Looked up lazily through sys.modules so the recorder never imports
+    the chain package itself (dumps work from store-less test rigs)."""
+    persistence = sys.modules.get("lighthouse_tpu.chain.persistence")
+    if persistence is None:
+        return None
+    try:
+        return persistence.last_recovery_report()
+    except Exception:  # pragma: no cover - best effort
+        return None
 
 
 def _chain_summary(chain) -> dict:
@@ -126,6 +143,7 @@ class FlightRecorder:
             doc["slo"] = {}
             doc["chains"] = []
             doc["processors"] = []
+        doc["recovery"] = _recovery_report()
         doc["log_tail"] = global_log_buffer().tail(LOG_TAIL)
         return _json_safe(doc)
 

@@ -6,4 +6,5 @@ LevelDB backend (here: the C++ kvstore in native/, via ctypes), state
 reconstruction by block replay (src/reconstruct.rs).
 """
 from .kv import KeyValueStore, MemoryStore, NativeKvStore, StoreError
-from .hot_cold import HotColdDB, Split, StoreConfig
+from .hot_cold import HotColdDB, Split, StoreConfig, StoreOp
+from .fsck import FsckReport, run_fsck

@@ -40,6 +40,29 @@ class ChunkedRootVector:
         chunk[off * ROOT_LEN:(off + 1) * ROOT_LEN] = root
         self.kv.put(self._key(ci), bytes(chunk))
 
+    def stage_puts(self, puts: dict[int, bytes]) -> list[tuple]:
+        """Fold many slot->root writes into per-chunk KV put ops (one op
+        per touched chunk) WITHOUT writing — the caller commits them in an
+        atomic `do_atomically` batch alongside its other freezer writes.
+        The read-modify-write of each chunk happens here, against the
+        currently-visible chunk contents."""
+        by_chunk: dict[int, dict[int, bytes]] = {}
+        for slot, root in puts.items():
+            if len(root) != ROOT_LEN:
+                raise ValueError("root must be 32 bytes")
+            ci, off = divmod(slot, CHUNK_SIZE)
+            by_chunk.setdefault(ci, {})[off] = root
+        ops: list[tuple] = []
+        for ci in sorted(by_chunk):
+            chunk = bytearray(self.kv.get(self._key(ci)) or b"")
+            for off, root in sorted(by_chunk[ci].items()):
+                need = (off + 1) * ROOT_LEN
+                if len(chunk) < need:
+                    chunk += b"\x00" * (need - len(chunk))
+                chunk[off * ROOT_LEN:(off + 1) * ROOT_LEN] = root
+            ops.append(("put", self._key(ci), bytes(chunk)))
+        return ops
+
     def get(self, slot: int) -> bytes | None:
         ci, off = divmod(slot, CHUNK_SIZE)
         chunk = self.kv.get(self._key(ci))

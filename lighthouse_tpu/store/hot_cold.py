@@ -11,6 +11,7 @@ Equivalent of /root/reference/beacon_node/store/src/hot_cold_store.rs:50:
 """
 from __future__ import annotations
 
+import os
 import struct
 import sys
 from dataclasses import dataclass
@@ -49,6 +50,47 @@ def _count(name: str, amount: float = 1) -> None:
 class Split:
     slot: int = 0
     state_root: bytes = b"\x00" * 32
+
+
+@dataclass
+class StoreOp:
+    """One logical mutation in an atomic hot-DB commit batch
+    (store/src/lib.rs StoreOp): build a list, hand it to
+    `HotColdDB.do_atomically`, and either every op lands or none does —
+    the crash-consistency unit for block import, head persistence and
+    migration."""
+
+    kind: str
+    key: bytes = b""
+    obj: object = None
+
+    @classmethod
+    def put_block(cls, block_root: bytes, signed_block) -> "StoreOp":
+        return cls("put_block", block_root, signed_block)
+
+    @classmethod
+    def put_state(cls, state_root: bytes, state) -> "StoreOp":
+        return cls("put_state", state_root, state)
+
+    @classmethod
+    def put_blobs(cls, block_root: bytes, blobs: list) -> "StoreOp":
+        return cls("put_blobs", block_root, blobs)
+
+    @classmethod
+    def delete_block(cls, block_root: bytes) -> "StoreOp":
+        return cls("delete_block", block_root)
+
+    @classmethod
+    def delete_state(cls, state_root: bytes) -> "StoreOp":
+        return cls("delete_state", state_root)
+
+    @classmethod
+    def put_item(cls, key: bytes, value: bytes) -> "StoreOp":
+        return cls("put_item", key, value)
+
+    @classmethod
+    def put_meta(cls, key: bytes, value: bytes) -> "StoreOp":
+        return cls("put_meta", key, value)
 
 
 @dataclass
@@ -100,6 +142,14 @@ class HotColdDB:
         from .schema_change import migrate_schema
         migrate_schema(self)
         self._put_meta(b"schema", struct.pack("<I", SCHEMA_VERSION))
+        if os.environ.get("LHTPU_FSCK_ON_OPEN"):
+            from .fsck import run_fsck
+            report = run_fsck(self)
+            if report.errors:
+                import logging
+                logging.getLogger("lighthouse_tpu.store").warning(
+                    "fsck at open found %d error(s): %s",
+                    len(report.errors), "; ".join(report.errors[:5]))
 
     # -- metadata ------------------------------------------------------------
 
@@ -116,11 +166,6 @@ class HotColdDB:
         slot, root = struct.unpack("<Q", raw[:8])[0], raw[8:40]
         return Split(slot, root)
 
-    def _store_split(self) -> None:
-        self._put_meta(b"split",
-                       struct.pack("<Q", self.split.slot)
-                       + self.split.state_root)
-
     def schema_version(self) -> int:
         raw = self._get_meta(b"schema")
         return struct.unpack("<I", raw)[0] if raw else 0
@@ -131,13 +176,74 @@ class HotColdDB:
     def get_item(self, key: bytes) -> bytes | None:
         return self.hot.get(ITEM + key)
 
-    # -- blocks --------------------------------------------------------------
+    # -- atomic commit batches ----------------------------------------------
 
-    def put_block(self, block_root: bytes, signed_block) -> None:
+    def _block_kv_ops(self, block_root: bytes, signed_block) -> list:
         fork = signed_block.fork_name
         data = bytes([fork.value]) + serialize(
             type(signed_block).ssz_type, signed_block)
-        self.hot.put(BLOCK + block_root, data)
+        return [("put", BLOCK + block_root, data)]
+
+    def _state_kv_ops(self, state_root: bytes, state: BeaconState) -> list:
+        p = self.T.preset
+        ops = []
+        if state.slot % p.slots_per_epoch == 0:
+            data = bytes([state.fork_name.value]) + state.serialize()
+            ops.append(("put", HOT_STATE_FULL + state_root, data))
+        latest_block_root = self._latest_block_root(state)
+        boundary_slot = (state.slot // p.slots_per_epoch) * p.slots_per_epoch
+        boundary_root = (state_root if state.slot == boundary_slot
+                         else state.state_roots[
+                             boundary_slot % p.slots_per_historical_root
+                         ].tobytes())
+        summary = struct.pack("<Q", state.slot) + latest_block_root \
+            + boundary_root
+        ops.append(("put", HOT_STATE_SUMMARY + state_root, summary))
+        return ops
+
+    def _blobs_kv_ops(self, block_root: bytes, blobs: list) -> list:
+        from ..ssz import List as SSZList
+        t = SSZList(self.T.BlobSidecar.ssz_type,
+                    self.T.preset.max_blob_commitments_per_block)
+        return [("put", BLOBS + block_root, serialize(t, blobs))]
+
+    def _kv_ops_for(self, op: StoreOp) -> list:
+        if op.kind == "put_block":
+            return self._block_kv_ops(op.key, op.obj)
+        if op.kind == "put_state":
+            return self._state_kv_ops(op.key, op.obj)
+        if op.kind == "put_blobs":
+            return self._blobs_kv_ops(op.key, op.obj)
+        if op.kind == "delete_block":
+            return [("delete", BLOCK + op.key, None)]
+        if op.kind == "delete_state":
+            return [("delete", HOT_STATE_FULL + op.key, None),
+                    ("delete", HOT_STATE_SUMMARY + op.key, None)]
+        if op.kind == "put_item":
+            return [("put", ITEM + op.key, op.obj)]
+        if op.kind == "put_meta":
+            return [("put", METADATA + op.key, op.obj)]
+        raise StoreError(f"unknown StoreOp kind {op.kind!r}")
+
+    def do_atomically(self, ops: list[StoreOp], fsync: bool = True) -> None:
+        """Commit a list of StoreOps as ONE atomic hot-DB batch: after a
+        crash either every op is visible or none is (native backends frame
+        the batch as a single CRC'd log record).  This is the only
+        sanctioned write path for block import / head persistence /
+        migration — graftlint's store-atomicity rule flags direct puts
+        there."""
+        kv_ops: list = []
+        for op in ops:
+            kv_ops.extend(self._kv_ops_for(op))
+        self.hot.do_atomically(kv_ops, fsync=fsync)
+        _count("store_batch_commit_total")
+        _count("store_hot_db_ops_total", len(kv_ops))
+
+    # -- blocks --------------------------------------------------------------
+
+    def put_block(self, block_root: bytes, signed_block) -> None:
+        for _op, key, value in self._block_kv_ops(block_root, signed_block):
+            self.hot.put(key, value)
         _count("store_hot_db_ops_total")
 
     def get_block(self, block_root: bytes):
@@ -151,16 +257,31 @@ class HotColdDB:
     def block_exists(self, block_root: bytes) -> bool:
         return self.hot.exists(BLOCK + block_root)
 
+    def iter_hot_blocks(self):
+        """(root, signed_block) over every hot block, ascending by slot —
+        the raw material fork-choice rebuild and fsck walk after a crash
+        ate the persisted snapshot.  Undecodable blocks are skipped."""
+        found = []
+        for key, _ in self.hot.iter_prefix(BLOCK):
+            root = key[len(BLOCK):]
+            try:
+                blk = self.get_block(root)
+            except Exception:
+                continue
+            if blk is not None:
+                found.append((blk.message.slot, root, blk))
+        found.sort(key=lambda t: t[0])
+        for _slot, root, blk in found:
+            yield root, blk
+
     def delete_block(self, block_root: bytes) -> None:
         self.hot.delete(BLOCK + block_root)
 
     # -- blobs ---------------------------------------------------------------
 
     def put_blobs(self, block_root: bytes, blobs: list) -> None:
-        from ..ssz import List as SSZList
-        t = SSZList(self.T.BlobSidecar.ssz_type,
-                    self.T.preset.max_blob_commitments_per_block)
-        self.hot.put(BLOBS + block_root, serialize(t, blobs))
+        for _op, key, value in self._blobs_kv_ops(block_root, blobs):
+            self.hot.put(key, value)
 
     def get_blobs(self, block_root: bytes) -> list | None:
         from ..ssz import List as SSZList
@@ -174,20 +295,18 @@ class HotColdDB:
     # -- hot states ----------------------------------------------------------
 
     def put_state(self, state_root: bytes, state: BeaconState) -> None:
-        p = self.T.preset
-        if state.slot % p.slots_per_epoch == 0:
-            data = bytes([state.fork_name.value]) + state.serialize()
-            self.hot.put(HOT_STATE_FULL + state_root, data)
-        latest_block_root = self._latest_block_root(state)
-        boundary_slot = (state.slot // p.slots_per_epoch) * p.slots_per_epoch
-        boundary_root = (state_root if state.slot == boundary_slot
-                         else state.state_roots[
-                             boundary_slot % p.slots_per_historical_root
-                         ].tobytes())
-        summary = struct.pack("<Q", state.slot) + latest_block_root \
-            + boundary_root
-        self.hot.put(HOT_STATE_SUMMARY + state_root, summary)
+        for _op, key, value in self._state_kv_ops(state_root, state):
+            self.hot.put(key, value)
         _count("store_hot_db_ops_total")
+
+    def hot_state_summary(self, state_root: bytes
+                          ) -> tuple[int, bytes, bytes] | None:
+        """(slot, latest_block_root, epoch_boundary_root) for a hot state,
+        or None when no (well-formed) summary exists."""
+        raw = self.hot.get(HOT_STATE_SUMMARY + state_root)
+        if raw is None or len(raw) != 72:
+            return None
+        return struct.unpack("<Q", raw[:8])[0], raw[8:40], raw[40:72]
 
     @staticmethod
     def _latest_block_root(state: BeaconState) -> bytes:
@@ -239,16 +358,33 @@ class HotColdDB:
         self.hot.delete(HOT_STATE_SUMMARY + state_root)
 
     def store_genesis(self, genesis_block_root: bytes,
-                      genesis_state: BeaconState) -> None:
+                      genesis_state: BeaconState,
+                      genesis_block=None) -> None:
         """Anchor the DB: genesis state goes to both hot and freezer (the
-        slot-0 restore point every cold reconstruction bottoms out on)."""
+        slot-0 restore point every cold reconstruction bottoms out on).
+
+        Commit order is the crash contract: freezer first, then ONE hot
+        batch whose `anchor_slot` meta is the commit point — a crash
+        between the two leaves a store with no anchor, which boots as
+        fresh and simply re-runs genesis."""
+        from ..utils.crashpoints import crashpoint
         root = genesis_state.hash_tree_root()
-        self.put_state(root, genesis_state)
-        self.freezer_put_state(genesis_state.slot, genesis_state)
-        self.freezer_put_block_root(genesis_state.slot, genesis_block_root)
-        self._put_meta(b"genesis_block_root", genesis_block_root)
-        self._put_meta(b"anchor_slot",
-                       struct.pack("<Q", genesis_state.slot))
+        slot = genesis_state.slot
+        cold_ops = [("put", FREEZER_STATE + struct.pack(">Q", slot),
+                     bytes([genesis_state.fork_name.value])
+                     + genesis_state.serialize())]
+        cold_ops.extend(self.block_roots.stage_puts(
+            {slot: genesis_block_root}))
+        self.cold.do_atomically(cold_ops)
+        _count("store_cold_db_ops_total", len(cold_ops))
+        crashpoint("genesis:mid_store")
+        ops = [StoreOp.put_state(root, genesis_state),
+               StoreOp.put_meta(b"genesis_block_root", genesis_block_root),
+               StoreOp.put_meta(b"anchor_slot", struct.pack("<Q", slot))]
+        if genesis_block is not None:
+            ops.insert(0, StoreOp.put_block(genesis_block_root,
+                                            genesis_block))
+        self.do_atomically(ops)
 
     def anchor_state(self) -> BeaconState | None:
         """The state this DB was anchored on (FromStore resume boots here)."""
@@ -376,40 +512,55 @@ class HotColdDB:
                           canonical_roots: dict[int, bytes],
                           abandoned_block_roots: list[bytes] = (),
                           abandoned_state_roots: list[bytes] = ()) -> None:
+        """Two commit points: (1) ONE cold batch lands every freezer write;
+        (2) ONE hot batch lands prunes + the advanced split.  A crash
+        between them leaves the old split in place, so the next migration
+        replays the (idempotent) freezer writes from the old boundary."""
+        from ..utils.crashpoints import crashpoint
         srp = self.config.slots_per_restore_point
-        for slot in range(self.split.slot, finalized_slot + 1):
-            root = canonical_roots.get(slot)
-            if root is not None:
-                self.freezer_put_block_root(slot, root)
-        # restore points + freezer state-root vector
+        block_root_puts: dict[int, bytes] = {}
+        state_root_puts: dict[int, bytes] = {}
+        cold_ops: list = []
         for slot in range(self.split.slot, finalized_slot + 1):
             root = canonical_roots.get(slot)
             if root is None:
                 continue
+            block_root_puts[slot] = root
             blk = self.get_block(root)
             if blk is not None:
-                self.freezer_put_state_root(slot, blk.message.state_root)
+                state_root_puts[slot] = blk.message.state_root
             if slot % srp == 0:
                 st = None
                 if blk is not None:
                     st = self.get_hot_state(blk.message.state_root)
                 if st is not None:
-                    self.freezer_put_state(slot, st)
-        # prune abandoned forks
-        for root in abandoned_block_roots:
-            self.delete_block(root)
-        for root in abandoned_state_roots:
-            self.delete_state(root)
-        # drop hot states strictly below the new split (keep the finalized one)
+                    cold_ops.append(
+                        ("put", FREEZER_STATE + struct.pack(">Q", slot),
+                         bytes([st.fork_name.value]) + st.serialize()))
+        cold_ops.extend(self.block_roots.stage_puts(block_root_puts))
+        cold_ops.extend(self.state_roots.stage_puts(state_root_puts))
+        self.cold.do_atomically(cold_ops, fsync=True)
+        _count("store_batch_commit_total")
+        _count("store_cold_db_ops_total", len(cold_ops))
+        crashpoint("migrate:mid_freeze")
+        # hot batch: prune abandoned forks + stale states, advance the split
+        hot_ops = [StoreOp.delete_block(root)
+                   for root in abandoned_block_roots]
+        hot_ops += [StoreOp.delete_state(root)
+                    for root in abandoned_state_roots]
+        # drop hot states strictly below the new split (keep the finalized
+        # one)
         for key, summary in list(self.hot.iter_prefix(HOT_STATE_SUMMARY)):
             slot = struct.unpack("<Q", summary[:8])[0]
             state_root = key[len(HOT_STATE_SUMMARY):]
             if slot < finalized_slot and state_root != finalized_state_root:
-                self.delete_state(state_root)
+                hot_ops.append(StoreOp.delete_state(state_root))
+        hot_ops.append(StoreOp.put_meta(
+            b"split", struct.pack("<Q", finalized_slot)
+            + finalized_state_root))
+        crashpoint("migrate:before_split_write")
+        self.do_atomically(hot_ops, fsync=True)
         self.split = Split(finalized_slot, finalized_state_root)
-        self._store_split()
-        self.hot.sync()
-        self.cold.sync()
 
     # -- iteration -----------------------------------------------------------
 

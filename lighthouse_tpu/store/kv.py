@@ -43,13 +43,36 @@ class KeyValueStore:
     def close(self) -> None:
         pass
 
-    def do_atomically(self, ops: list[tuple[str, bytes, bytes | None]]) -> None:
-        """ops: ("put", key, value) | ("delete", key, None)."""
-        for op, key, value in ops:
-            if op == "put":
-                self.put(key, value)
-            else:
-                self.delete(key)
+    def do_atomically(self, ops: list[tuple[str, bytes, bytes | None]],
+                      fsync: bool = False) -> None:
+        """ops: ("put", key, value) | ("delete", key, None).
+
+        The batch is all-or-nothing: a failing op rolls the already-applied
+        prefix back before re-raising, so a half-applied batch is never
+        observable.  Backends with a native batch primitive (NativeKvStore)
+        override this with a genuinely atomic commit; `fsync` asks for a
+        durability barrier where the backend supports one.
+        """
+        undo: list[tuple[str, bytes, bytes | None]] = []
+        try:
+            for op, key, value in ops:
+                if op not in ("put", "delete"):
+                    raise StoreError(f"unknown batch op {op!r}")
+                undo.append((op, key, self.get(key)))
+                if op == "put":
+                    self.put(key, value)
+                else:
+                    self.delete(key)
+        except BaseException:
+            for _op, key, old in reversed(undo):
+                try:
+                    if old is None:
+                        self.delete(key)
+                    else:
+                        self.put(key, old)
+                except Exception:       # rollback is best-effort
+                    pass
+            raise
         self.sync()
 
 
@@ -69,6 +92,30 @@ class MemoryStore(KeyValueStore):
     def delete(self, key: bytes) -> None:
         with self._lock:
             self._data.pop(key, None)
+
+    def do_atomically(self, ops: list[tuple[str, bytes, bytes | None]],
+                      fsync: bool = False) -> None:
+        """Genuinely atomic: the lock is held across the whole batch (no
+        reader interleaves with a half-applied batch) and a failing op
+        restores every prior write before re-raising."""
+        with self._lock:
+            undo: list[tuple[bytes, bytes | None]] = []
+            try:
+                for op, key, value in ops:
+                    undo.append((key, self._data.get(key)))
+                    if op == "put":
+                        self._data[key] = bytes(value)
+                    elif op == "delete":
+                        self._data.pop(key, None)
+                    else:
+                        raise StoreError(f"unknown batch op {op!r}")
+            except BaseException:
+                for key, old in reversed(undo):
+                    if old is None:
+                        self._data.pop(key, None)
+                    else:
+                        self._data[key] = old
+                raise
 
     def iter_prefix(self, prefix: bytes):
         with self._lock:
@@ -101,6 +148,9 @@ def _load_native() -> ctypes.CDLL:
     lib.kv_delete.restype = ctypes.c_int
     lib.kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                               ctypes.c_size_t]
+    lib.kv_write_batch.restype = ctypes.c_int
+    lib.kv_write_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_size_t, ctypes.c_int]
     lib.kv_get_len.restype = ctypes.c_int64
     lib.kv_get_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.c_size_t]
@@ -171,6 +221,29 @@ class NativeKvStore(KeyValueStore):
                 yield key, val
         finally:
             self._lib.kv_iter_destroy(it)
+
+    def do_atomically(self, ops: list[tuple[str, bytes, bytes | None]],
+                      fsync: bool = False) -> None:
+        """One CRC'd batch record in the native log: replay applies it
+        all-or-nothing, so partial-batch bytes are never visible after a
+        crash.  `fsync=True` adds an fsync barrier at the commit point."""
+        import struct
+        parts = [struct.pack("<I", len(ops))]
+        for op, key, value in ops:
+            if op == "put":
+                parts.append(struct.pack("<II", len(key), len(value)))
+                parts.append(bytes(key))
+                parts.append(bytes(value))
+            elif op == "delete":
+                parts.append(struct.pack("<II", len(key), 0xFFFFFFFF))
+                parts.append(bytes(key))
+            else:
+                raise StoreError(f"unknown batch op {op!r}")
+        payload = b"".join(parts)
+        rc = self._lib.kv_write_batch(self._h, payload, len(payload),
+                                      1 if fsync else 0)
+        if rc != 0:
+            raise StoreError(f"kv batch write error (rc={rc})")
 
     def sync(self) -> None:
         self._lib.kv_sync(self._h)

@@ -38,11 +38,57 @@ uint32_t crc32(const uint8_t* data, size_t n, uint32_t crc = 0) {
 }
 
 constexpr uint32_t kDeleteMarker = 0xFFFFFFFFu;
+// klen sentinel framing a whole write batch as ONE CRC'd record: the payload
+// holds [u32 count] then per-op [u32 klen][u32 vlen|kDeleteMarker][key][val].
+// Replay applies a batch only when its CRC checks out, so a crash mid-batch
+// (torn tail) drops the entire batch — never a prefix of it.
+constexpr uint32_t kBatchMarker = 0xFFFFFFFEu;
 
 struct Record {
   uint64_t offset;  // offset of value payload in log
   uint32_t vlen;
 };
+
+struct BatchOp {
+  size_t key_off;  // offsets within the batch payload
+  uint32_t klen;
+  size_t val_off;
+  uint32_t vlen;
+  bool is_del;
+};
+
+// Walk a batch payload into per-op offsets; false on malformed structure.
+bool parse_batch(const uint8_t* p, size_t n, std::vector<BatchOp>* out) {
+  if (n < 4) return false;
+  uint32_t count;
+  memcpy(&count, p, 4);
+  size_t cur = 4;
+  out->clear();
+  out->reserve(count);
+  for (uint32_t i = 0; i < count; i++) {
+    if (cur + 8 > n) return false;
+    uint32_t klen, vlen;
+    memcpy(&klen, p + cur, 4);
+    memcpy(&vlen, p + cur + 4, 4);
+    cur += 8;
+    bool is_del = vlen == kDeleteMarker;
+    if (klen > (1u << 28) || (!is_del && vlen > (1u << 30))) return false;
+    BatchOp op;
+    op.key_off = cur;
+    op.klen = klen;
+    op.is_del = is_del;
+    if (cur + klen > n) return false;
+    cur += klen;
+    op.val_off = cur;
+    op.vlen = is_del ? 0 : vlen;
+    if (!is_del) {
+      if (cur + vlen > n) return false;
+      cur += vlen;
+    }
+    out->push_back(op);
+  }
+  return cur == n;
+}
 
 struct Store {
   std::string path;
@@ -52,15 +98,49 @@ struct Store {
   uint64_t live_bytes = 0;
   uint64_t total_bytes = 0;
 
+  // Index bookkeeping for one logical op (shared by replay and append).
+  void apply_op(const std::string& key, uint64_t voff, uint32_t vlen,
+                bool is_del) {
+    auto it = index.find(key);
+    if (it != index.end()) live_bytes -= it->second.vlen + key.size();
+    if (is_del) {
+      if (it != index.end()) index.erase(it);
+    } else {
+      index[key] = Record{voff, vlen};
+      live_bytes += vlen + key.size();
+    }
+  }
+
   bool replay() {
     FILE* f = fopen(path.c_str(), "rb");
     if (!f) return true;  // fresh store
     uint64_t off = 0, good_end = 0;
     std::vector<uint8_t> buf;
+    std::vector<BatchOp> ops;
     for (;;) {
       uint32_t hdr[3];  // klen, vlen, crc
       if (fread(hdr, 1, 12, f) != 12) break;
       uint32_t klen = hdr[0], vlen = hdr[1], crc = hdr[2];
+      if (klen == kBatchMarker) {
+        // one batch = one record: CRC gates the whole payload, so either
+        // every op below lands in the index or none does
+        uint32_t payload = vlen;
+        if (payload > (1u << 30)) break;
+        buf.resize(payload);
+        if (payload && fread(buf.data(), 1, payload, f) != payload) break;
+        uint32_t want = crc32(buf.data(), payload,
+                              crc32(reinterpret_cast<uint8_t*>(hdr), 8));
+        if (want != crc) break;  // torn/corrupt tail
+        if (!parse_batch(buf.data(), payload, &ops)) break;
+        for (const auto& op : ops) {
+          std::string key(reinterpret_cast<char*>(buf.data()) + op.key_off,
+                          op.klen);
+          apply_op(key, off + 12 + op.val_off, op.vlen, op.is_del);
+        }
+        off += 12 + payload;
+        good_end = off;
+        continue;
+      }
       bool is_del = vlen == kDeleteMarker;
       uint32_t payload = klen + (is_del ? 0 : vlen);
       if (klen > (1u << 28) || (!is_del && vlen > (1u << 30))) break;
@@ -70,18 +150,7 @@ struct Store {
                             crc32(reinterpret_cast<uint8_t*>(hdr), 8));
       if (want != crc) break;  // torn/corrupt tail
       std::string key(reinterpret_cast<char*>(buf.data()), klen);
-      if (is_del) {
-        auto it = index.find(key);
-        if (it != index.end()) {
-          live_bytes -= it->second.vlen + key.size();
-          index.erase(it);
-        }
-      } else {
-        auto it = index.find(key);
-        if (it != index.end()) live_bytes -= it->second.vlen + key.size();
-        index[key] = Record{off + 12 + klen, vlen};
-        live_bytes += vlen + key.size();
-      }
+      apply_op(key, off + 12 + klen, is_del ? 0 : vlen, is_del);
       off += 12 + payload;
       good_end = off;
     }
@@ -101,33 +170,73 @@ struct Store {
     return true;
   }
 
+  // A failed/partial fwrite leaves garbage after total_bytes; chop it off so
+  // later appends still land where the index expects them.
+  void truncate_to_good_end() {
+    if (!log) return;
+    fflush(log);
+#ifndef _WIN32
+    if (ftruncate(fileno(log), static_cast<off_t>(total_bytes)) != 0) {
+      /* best effort; replay's CRC check still protects readers */
+    }
+#endif
+    fseek(log, 0, SEEK_END);
+  }
+
+  bool write_record(const uint32_t hdr_kl, const uint32_t hdr_vl,
+                    const uint8_t* payload, size_t plen) {
+    uint32_t hdr[3];
+    hdr[0] = hdr_kl;
+    hdr[1] = hdr_vl;
+    hdr[2] = crc32(payload, plen, crc32(reinterpret_cast<uint8_t*>(hdr), 8));
+    if (fwrite(hdr, 1, 12, log) != 12 ||
+        (plen && fwrite(payload, 1, plen, log) != plen)) {
+      truncate_to_good_end();
+      return false;
+    }
+    return true;
+  }
+
   bool append(const std::string& key, const uint8_t* val, uint32_t vlen,
               bool is_del) {
-    uint32_t hdr[3];
-    hdr[0] = static_cast<uint32_t>(key.size());
-    hdr[1] = is_del ? kDeleteMarker : vlen;
     std::vector<uint8_t> payload(key.size() + (is_del ? 0 : vlen));
     memcpy(payload.data(), key.data(), key.size());
     if (!is_del && vlen) memcpy(payload.data() + key.size(), val, vlen);
-    hdr[2] = crc32(payload.data(), payload.size(),
-                   crc32(reinterpret_cast<uint8_t*>(hdr), 8));
-    if (fwrite(hdr, 1, 12, log) != 12) return false;
-    if (!payload.empty() &&
-        fwrite(payload.data(), 1, payload.size(), log) != payload.size())
+    if (!write_record(static_cast<uint32_t>(key.size()),
+                      is_del ? kDeleteMarker : vlen,
+                      payload.data(), payload.size()))
       return false;
     uint64_t voff = total_bytes + 12 + key.size();
     total_bytes += 12 + payload.size();
-    if (!is_del) {
-      auto it = index.find(key);
-      if (it != index.end()) live_bytes -= it->second.vlen + key.size();
-      index[key] = Record{voff, vlen};
-      live_bytes += vlen + key.size();
-    } else {
-      auto it = index.find(key);
-      if (it != index.end()) {
-        live_bytes -= it->second.vlen + key.size();
-        index.erase(it);
-      }
+    apply_op(key, voff, is_del ? 0 : vlen, is_del);
+    return true;
+  }
+
+  // Append a whole batch as one record; the index is only touched after the
+  // full record hit the log (and optionally fsync'd), so an in-process write
+  // failure leaves the store exactly as before the call.
+  bool append_batch(const uint8_t* payload, size_t plen, bool do_fsync) {
+    std::vector<BatchOp> ops;
+    if (plen > (1u << 30) || !parse_batch(payload, plen, &ops)) return false;
+    if (!write_record(kBatchMarker, static_cast<uint32_t>(plen), payload,
+                      plen))
+      return false;
+    if (fflush(log) != 0) {
+      truncate_to_good_end();
+      return false;
+    }
+#ifndef _WIN32
+    if (do_fsync && fsync(fileno(log)) != 0) {
+      truncate_to_good_end();
+      return false;
+    }
+#endif
+    uint64_t off = total_bytes;
+    total_bytes += 12 + plen;
+    for (const auto& op : ops) {
+      std::string key(reinterpret_cast<const char*>(payload) + op.key_off,
+                      op.klen);
+      apply_op(key, off + 12 + op.val_off, op.vlen, op.is_del);
     }
     return true;
   }
@@ -173,6 +282,22 @@ int kv_put(void* h, const uint8_t* key, size_t klen, const uint8_t* val,
                    static_cast<uint32_t>(vlen), false)
              ? 0
              : -1;
+}
+
+// Atomic write batch. `payload` uses the batch wire format
+// ([u32 count] then per-op [u32 klen][u32 vlen|0xFFFFFFFF][key][val]);
+// the whole batch becomes ONE CRC'd log record applied all-or-nothing on
+// replay. `do_fsync` != 0 adds an fsync barrier after the record (the
+// commit point for block-import / migration batches). Returns 0 on
+// success, -1 on write failure (log truncated back, index untouched),
+// -2 on a malformed payload.
+int kv_write_batch(void* h, const uint8_t* payload, size_t plen,
+                   int do_fsync) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::vector<BatchOp> ops;
+  if (plen > (1u << 30) || !parse_batch(payload, plen, &ops)) return -2;
+  return s->append_batch(payload, plen, do_fsync != 0) ? 0 : -1;
 }
 
 int kv_delete(void* h, const uint8_t* key, size_t klen) {

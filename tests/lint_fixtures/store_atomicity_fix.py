@@ -1,0 +1,46 @@
+"""Seeded fixture for the store-atomicity rule.
+
+True positives are tagged ``seeded``: direct store mutations on
+import/migrate-shaped code that bypass the HotColdDB.do_atomically batch
+API.  AST-scanned only, never imported.
+"""
+
+
+class StoreOp:
+    @classmethod
+    def put_block(cls, root, block):
+        return ("put_block", root, block)
+
+    @classmethod
+    def put_state(cls, root, state):
+        return ("put_state", root, state)
+
+
+class ImportPipeline:
+    def __init__(self, store):
+        self.store = store
+
+    def import_block(self, block_root, signed_block, state):
+        # the torn window: a crash between these two leaves a block
+        # whose post-state is missing
+        self.store.put_block(block_root, signed_block)  # seeded
+        self.store.put_state(signed_block.state_root, state)  # seeded
+
+    def advance_split(self, slot, state_root):
+        self.store._put_meta(b"split", bytes(8) + state_root)  # seeded
+
+    def import_block_atomically(self, block_root, signed_block, state):
+        # the sanctioned shape: StoreOp constructors + one batch commit
+        self.store.do_atomically(
+            [StoreOp.put_block(block_root, signed_block),
+             StoreOp.put_state(signed_block.state_root, state)])
+
+
+def backfill(store, root, sb):
+    store.put_block(root, sb)  # seeded
+    store.freezer_put_block_root(sb.slot, root)
+
+
+def batched_backfill(store, root, sb):
+    store.do_atomically([StoreOp.put_block(root, sb)], fsync=False)
+    store.freezer_put_block_root(sb.slot, root)

@@ -1,0 +1,227 @@
+"""Restart-recovery suite: kill -9 at every registered crashpoint, reopen,
+assert the store repaired itself and the chain keeps importing.
+
+A child process drives a 4-epoch chain (finalization → migration →
+persistence all happen) against the native store with one crashpoint
+armed; it dies there with ``os._exit(86)`` — no flushes, no atexit.  The
+parent then reopens the same database, resumes FromStore, and asserts the
+recovery invariants:
+
+- ``run_fsck`` reports no errors (after resume's own repairs);
+- the head is in fork choice, its block is stored, its state loadable;
+- importing continues: the deterministic reference chain's remaining
+  blocks apply cleanly and converge on the same head.
+
+Log-corruption scenarios (torn tail, mid-file bit flip) reuse a completed
+child run and mutilate the hot log directly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainBuilder, BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.ssz import htr
+from lighthouse_tpu.store import HotColdDB, NativeKvStore, run_fsck
+from lighthouse_tpu.utils.crashpoints import CRASH_EXIT_CODE, REGISTRY
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+CHILD = """
+import os
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.specs import minimal_spec
+from lighthouse_tpu.store import HotColdDB, NativeKvStore
+
+bls.set_backend("fake")
+spec = minimal_spec()
+db = os.environ["LHTPU_TEST_DB"]
+store = HotColdDB(NativeKvStore(os.path.join(db, "hot.db")),
+                  NativeKvStore(os.path.join(db, "cold.db")), spec)
+h = BeaconChainHarness(spec, 64, store=store)
+h.extend_chain(4 * spec.preset.slots_per_epoch)
+h.chain.persist()
+print("COMPLETED", h.chain.head().head_block_root.hex())
+"""
+
+#: later hits for the import sites so the crash lands mid-chain, with
+#: real history on both sides of the tear
+SITE_HITS = {"block_import:before_batch": 10,
+             "block_import:after_state_write": 10}
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    bls.set_backend("fake")
+    yield
+
+
+def _run_child(db_dir, site=None, hit=1):
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LHTPU_TEST_DB"] = str(db_dir)
+    env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH",
+                                                              "")
+    env.pop("LHTPU_CRASHPOINT", None)
+    env.pop("LHTPU_CRASHPOINT_HIT", None)
+    if site is not None:
+        env["LHTPU_CRASHPOINT"] = site
+        env["LHTPU_CRASHPOINT_HIT"] = str(hit)
+    return subprocess.run([sys.executable, "-c", CHILD], env=env,
+                          cwd=str(REPO_ROOT), capture_output=True,
+                          text=True, timeout=600)
+
+
+@pytest.fixture(scope="module")
+def ref():
+    """The deterministic reference chain: same spec/keys/clock as the
+    child, two slots past the child's stopping point, so the parent can
+    hand the recovered chain exactly the blocks it's missing."""
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = BeaconChainHarness(spec, 64)
+    roots = h.extend_chain(4 * spec.preset.slots_per_epoch + 2)
+    return {"spec": spec,
+            "blocks": [h.chain.store.get_block(r) for r in roots],
+            "head_root": h.chain.head().head_block_root,
+            "top_slot": h.chain.slot()}
+
+
+@pytest.fixture(scope="module")
+def completed_db(tmp_path_factory, ref):
+    """One un-crashed child run — the baseline the corruption tests mutate
+    copies of.  Doubles as the determinism check: the child's head must
+    equal the in-process reference chain's head at the same slot."""
+    db = tmp_path_factory.mktemp("completed")
+    proc = _run_child(db)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "COMPLETED" in proc.stdout
+    child_head = proc.stdout.split("COMPLETED", 1)[1].strip()
+    spe = ref["spec"].preset.slots_per_epoch
+    assert child_head == htr(ref["blocks"][4 * spe - 1].message).hex()
+    return db
+
+
+def _recover(db_dir, ref):
+    spec = ref["spec"]
+    store = HotColdDB(NativeKvStore(os.path.join(db_dir, "hot.db")),
+                      NativeKvStore(os.path.join(db_dir, "cold.db")), spec)
+    clock = ManualSlotClock(0, spec.seconds_per_slot,
+                            current_slot=ref["top_slot"])
+    chain = (BeaconChainBuilder(spec)
+             .resume_from_store(store)
+             .slot_clock(clock)
+             .build())
+    return store, chain
+
+
+def _assert_recovered(store, chain, ref):
+    """The post-crash invariants every scenario must satisfy."""
+    report = run_fsck(store)
+    assert report.clean, report.render()
+    head_root = chain.head().head_block_root
+    assert chain.fork_choice.contains_block(head_root)
+    head_block = store.get_block(head_root)
+    assert head_block is not None
+    assert store.get_hot_state(head_block.message.state_root) is not None
+    # import continues: feed whatever the crash cost us, converge on the
+    # reference head (blocks below the recovered anchor are finalized
+    # history — their parents are intentionally outside fork choice)
+    for sb in ref["blocks"]:
+        if chain.fork_choice.contains_block(htr(sb.message)) or \
+                not chain.fork_choice.contains_block(sb.message.parent_root):
+            continue
+        chain.process_block(sb)
+    assert chain.head().head_block_root == ref["head_root"]
+
+
+def test_registry_covers_commit_sequence():
+    assert len(REGISTRY) >= 6
+    prefixes = {name.split(":")[0] for name in REGISTRY}
+    assert {"genesis", "block_import", "persist", "migrate"} <= prefixes
+
+
+@pytest.mark.parametrize("site", sorted(n for n in REGISTRY
+                                        if not n.startswith("genesis")))
+def test_crash_at_site_then_recover(tmp_path, ref, site):
+    proc = _run_child(tmp_path, site=site, hit=SITE_HITS.get(site, 1))
+    assert proc.returncode == CRASH_EXIT_CODE, \
+        f"{site}: rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    assert "COMPLETED" not in proc.stdout, f"{site} never fired"
+    store, chain = _recover(tmp_path, ref)
+    _assert_recovered(store, chain, ref)
+
+
+def test_crash_during_genesis_boots_fresh(tmp_path, ref):
+    proc = _run_child(tmp_path, site="genesis:mid_store")
+    assert proc.returncode == CRASH_EXIT_CODE, proc.stderr[-2000:]
+    spec = ref["spec"]
+    store = HotColdDB(NativeKvStore(os.path.join(tmp_path, "hot.db")),
+                      NativeKvStore(os.path.join(tmp_path, "cold.db")),
+                      spec)
+    # the anchor meta (genesis' commit point) never landed: no resume
+    assert store.anchor_state() is None
+    with pytest.raises(ValueError):
+        BeaconChainBuilder(spec).resume_from_store(store)
+    # genesis simply re-runs on the same database
+    h = BeaconChainHarness(spec, 64, store=store)
+    h.set_slot(ref["top_slot"])
+    _assert_recovered(store, h.chain, ref)
+
+
+@pytest.mark.parametrize("cut", [1, 7, 64])
+def test_torn_log_tail_recovery(tmp_path, ref, completed_db, cut):
+    db = tmp_path / "db"
+    shutil.copytree(completed_db, db)
+    hot = db / "hot.db"
+    size = hot.stat().st_size
+    with open(hot, "r+b") as f:
+        f.truncate(size - cut)
+    store, chain = _recover(db, ref)
+    _assert_recovered(store, chain, ref)
+
+
+def test_fsck_cli_on_completed_db(completed_db):
+    """The offline tool agrees with the in-process checker: a cleanly
+    shut-down database exits 0 with parseable JSON."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "store" / "fsck.py"),
+         "--json", str(completed_db)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["clean"] is True
+    assert report["checked"].get("blocks", 0) > 0
+
+
+def test_fsck_cli_rejects_missing_db(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "store" / "fsck.py"),
+         str(tmp_path / "nope")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+
+
+def test_bit_flip_mid_log_recovery(tmp_path, ref, completed_db):
+    """A flipped bit fails that record's CRC; replay stops at the last
+    good record, dropping the whole suffix.  Because every commit is one
+    record, the surviving prefix is still a consistent store."""
+    db = tmp_path / "db"
+    shutil.copytree(completed_db, db)
+    hot = db / "hot.db"
+    raw = bytearray(hot.read_bytes())
+    pos = (len(raw) * 3) // 4
+    raw[pos] ^= 0x40
+    hot.write_bytes(bytes(raw))
+    store, chain = _recover(db, ref)
+    _assert_recovered(store, chain, ref)

@@ -222,6 +222,23 @@ def test_flight_dump_round_trips_through_doctor(tmp_path):
     assert all(i["correlations"] for i in diag["incidents"])
 
 
+def test_flight_dump_carries_recovery_report_to_doctor(tmp_path,
+                                                       monkeypatch):
+    from lighthouse_tpu.chain import persistence
+    report = {"restored": True, "fork_choice_rebuilt": True,
+              "repairs": ["head item stale (seq 3 < fork-choice seq 4); "
+                          "derived head from fork choice"],
+              "op_pool_skipped": 2, "head_walked_back": 0, "seq": 4}
+    monkeypatch.setattr(persistence, "LAST_RECOVERY", report)
+    rec = flight.FlightRecorder(_storm_watch(), dump_dir=str(tmp_path))
+    diag = doctor.diagnose(doctor.load(rec.dump(reason="unit")))
+    assert diag["recovery"]["fork_choice_rebuilt"] is True
+    assert diag["recovery"]["repairs"] == report["repairs"]
+    rendered = doctor.render(diag)
+    assert "fork choice REBUILT" in rendered
+    assert "derived head from fork choice" in rendered
+
+
 def test_doctor_golden_report():
     path = os.path.join(FIXTURES, "dump_v1.json")
     diag = doctor.diagnose(doctor.load(path))

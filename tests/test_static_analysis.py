@@ -39,6 +39,7 @@ RULE_FIXTURE = {
     "shutdown-order": "shutdown_order_fix.py",
     "compile-budget": "compile_budget_fix.py",
     "cow-discipline": "cow_discipline_fix.py",
+    "store-atomicity": "store_atomicity_fix.py",
 }
 
 
