@@ -81,6 +81,22 @@ CATALOG: dict[str, tuple[str, str]] = {
         ("counter", "Backfill batches processed"),
     "sync_parent_lookups_total": ("counter", "Parent-root lookups"),
     "sync_state": ("gauge", "0 synced / 1 range-syncing"),
+    "sync_penalties_total":
+        ("counter", "Sync-path peer penalties (per-reason counters are "
+                    "exposed as sync_penalties_total_<reason>)"),
+    "sync_request_deadline_expired_total":
+        ("counter", "Sync requests individually failed by their own "
+                    "deadline (per-request wheel, not a global stall)"),
+    "sync_pump_global_stall_total":
+        ("counter", "Pump passes that failed every in-flight request at "
+                    "once — structurally zero since the per-request "
+                    "deadline wheel; kept as a tripwire"),
+    "sync_batch_validation_rejects_total":
+        ("counter", "Range/backfill batches rejected by download-time "
+                    "validation before reaching process_segment"),
+    "sync_peer_quarantined_total":
+        ("counter", "Peers quarantined by sync backoff after repeated "
+                    "request failures"),
     # -- beacon processor (beacon_processor/src/metrics) ----------------
     "beacon_processor_work_events_total":
         ("counter", "Work items submitted"),

@@ -23,18 +23,31 @@ Three pieces (ISSUE 7 tentpole, ROADMAP item 4):
     A Transport subclass wired to the injector: dials consult the cut
     matrix, inbound upgrades of cut peers are refused post-handshake,
     and each registered peer's ``send_gossip_rpc`` is wrapped with the
-    link policy.  Req/resp (sync, status) is intentionally NOT
-    per-frame-faulted: a cut link has no connection at all, and a live
-    link's RPC integrity is what yamux provides — dropping arbitrary
-    mux frames would corrupt the stream state machine rather than model
-    a real network fault.
+    link policy.  Req/resp mux frames are still never dropped (that
+    would corrupt the yamux state machine, not model a network fault) —
+    instead ISSUE 11 adds *application-level* req/resp adversaries:
+
+``PeerBehavior``
+    A byzantine req/resp serving policy for one directed link,
+    installed with ``injector.set_behavior(server, client, behavior)``
+    the way gossip faults use ``set_link``.  The server's
+    ``FaultyTransport`` intercepts inbound RPC streams from that client
+    and serves them adversarially — ``stall`` (read the request, never
+    answer, RST late), ``junk`` (answer with real decodable blocks from
+    the WRONG slot range), ``truncate`` (serve then drop the tail of
+    the chunk stream), ``trickle`` (slowloris: long pauses between
+    chunks), ``lying_status`` (a fake-ahead STATUS) — while every other
+    link is served honestly.  This is the fabric the byzantine sync
+    scenarios point at range sync and backfill.
 """
 from __future__ import annotations
 
 import random
 import threading
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 
+from . import rpc as rpc_mod
 from .transport import Transport
 
 
@@ -47,6 +60,47 @@ class ScenarioClock:
     def advance(self, n: int = 1) -> int:
         self.tick += n
         return self.tick
+
+
+_BEHAVIOR_KINDS = ("stall", "junk", "truncate", "trickle", "lying_status")
+
+
+@dataclass
+class PeerBehavior:
+    """Byzantine req/resp serving policy for one directed link
+    (server label -> client label).  The server still speaks the wire
+    protocol correctly — chunk framing, result codes, snappy — so the
+    client's decode succeeds and the *content* defenses (download-time
+    validation, deadlines, STATUS sanity) are what must catch it.
+
+    kinds:
+      ``stall``        read the request, answer nothing, RST after
+                       ``stall_secs`` (or when the peer/stream dies).
+      ``junk``         serve real, decodable blocks from the WRONG slot
+                       range (request shifted by ``slot_shift``, default
+                       the request's own count) — guaranteed
+                       ``out_of_range`` at download-time validation.
+      ``truncate``     serve honestly but drop the tail of the chunk
+                       stream, keeping ``keep_fraction`` of the chunks.
+      ``trickle``      slowloris: sleep ``chunk_delay`` between chunks.
+      ``lying_status`` answer STATUS with ``status_lie`` fields merged
+                       over the honest response (fake-ahead head).
+    """
+    kind: str
+    protocols: tuple = ("beacon_blocks_by_range",)
+    stall_secs: float = 8.0
+    keep_fraction: float = 0.5
+    chunk_delay: float = 0.0
+    slot_shift: int | None = None
+    status_lie: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.kind not in _BEHAVIOR_KINDS:
+            raise ValueError(f"unknown behavior kind {self.kind!r}")
+        if self.kind == "lying_status" and \
+                self.protocols == ("beacon_blocks_by_range",):
+            # the default protocol tuple makes no sense for a STATUS liar
+            self.protocols = ("status",)
 
 
 @dataclass
@@ -73,6 +127,7 @@ class FaultInjector:
         self.clock = clock or ScenarioClock()
         self._lock = threading.Lock()
         self._policies: dict[tuple[str, str], LinkPolicy] = {}
+        self._behaviors: dict[tuple[str, str], PeerBehavior] = {}
         self._transports: dict[str, Transport] = {}
         self._labels: dict[str, str] = {}       # node_id hex -> label
         self._addrs: dict[tuple[str, int], str] = {}
@@ -85,6 +140,8 @@ class FaultInjector:
         self.frames_reordered = 0
         self.dials_refused = 0
         self.links_severed = 0
+        #: byzantine req/resp serves, by behavior kind
+        self.behaviors_served: dict[str, int] = {}
 
     # -- registration --------------------------------------------------------
 
@@ -118,6 +175,29 @@ class FaultInjector:
             if symmetric:
                 self._sever(dst, src)
 
+    def set_behavior(self, src: str, dst: str,
+                     behavior: PeerBehavior | None) -> None:
+        """Install (or clear, with None) a byzantine serving behavior on
+        the directed link src -> dst: requests FROM dst are served
+        adversarially BY src's transport.  Directed only — a byzantine
+        server is byzantine toward a chosen victim, not symmetric."""
+        with self._lock:
+            if behavior is None:
+                self._behaviors.pop((src, dst), None)
+            else:
+                self._behaviors[(src, dst)] = behavior
+
+    def behavior(self, src: str | None, dst: str | None) \
+            -> PeerBehavior | None:
+        if src is None or dst is None:
+            return None
+        return self._behaviors.get((src, dst))
+
+    def note_behavior(self, kind: str) -> None:
+        with self._lock:
+            self.behaviors_served[kind] = \
+                self.behaviors_served.get(kind, 0) + 1
+
     def partition(self, *groups) -> None:
         """Cut every link between nodes in different label groups."""
         cut = LinkPolicy(cut=True)
@@ -132,6 +212,7 @@ class FaultInjector:
         while in flight; delivering them now models late arrival)."""
         with self._lock:
             self._policies.clear()
+            self._behaviors.clear()
             due, self._delayed = self._delayed, []
         for _tick, _seq, _link, send_fn, frame in sorted(due,
                                                          key=lambda d: d[1]):
@@ -222,6 +303,102 @@ class FaultInjector:
 _DEFAULT = LinkPolicy()
 
 
+# -- byzantine req/resp serving ----------------------------------------------
+
+def _interruptible_sleep(peer, stream, secs: float) -> None:
+    """Sleep up to `secs` on a server stream thread, waking early when
+    the peer or stream dies so scenario teardown never blocks on us."""
+    deadline = time.monotonic() + secs
+    while time.monotonic() < deadline:
+        if not getattr(peer, "alive", False) or stream.reset:
+            return
+        time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+
+
+def _serve_byzantine(raw_serve, behavior: PeerBehavior, peer,
+                     spec, stream) -> None:
+    """Serve one inbound RPC stream adversarially.  Wire framing stays
+    protocol-correct (the client must successfully DECODE the lie); only
+    the content / timing is hostile."""
+    if behavior.kind == "stall":
+        # read the request so the client believes it was accepted, then
+        # go silent; the client's per-request deadline is the defense
+        try:
+            if spec.name != "metadata":
+                rpc_mod.read_payload(stream)
+        except Exception:
+            pass
+        _interruptible_sleep(peer, stream, behavior.stall_secs)
+        try:
+            stream.rst()
+        except Exception:
+            pass
+        return
+    handlers = getattr(getattr(raw_serve, "__self__", None), "handlers", {})
+    handler = handlers.get(spec.name)
+    if handler is None:
+        # no honest handler to pervert — fall back to the real server
+        raw_serve(peer, spec.id, stream)
+        return
+    try:
+        req_ssz = b"" if spec.name == "metadata" \
+            else rpc_mod.read_payload(stream)
+        req = spec.dec_req(req_ssz)
+    except Exception:
+        try:
+            stream.rst()
+        except Exception:
+            pass
+        return
+    if behavior.kind == "junk" and isinstance(req, dict) \
+            and "start_slot" in req:
+        # shift the requested window so the HONEST handler serves real,
+        # decodable, hash-linked blocks from the wrong range — the junk
+        # that only download-time validation (out_of_range) can catch
+        req = dict(req)
+        start = int(req["start_slot"])
+        shift = behavior.slot_shift if behavior.slot_shift is not None \
+            else max(1, int(req.get("count", 1)))
+        req["start_slot"] = start - shift if start >= shift \
+            else start + shift
+    try:
+        resp = handler(peer, req)
+    except Exception:
+        try:
+            stream.write(bytes([rpc_mod.RESULT_SERVER_ERROR]))
+            rpc_mod.write_payload(stream, b"server error")
+            stream.close()
+        except Exception:
+            pass
+        return
+    if behavior.kind == "lying_status" and isinstance(resp, dict) \
+            and behavior.status_lie:
+        resp = {**resp, **behavior.status_lie}
+    try:
+        if spec.chunked:
+            chunks = list(resp or [])
+            if behavior.kind == "truncate" and len(chunks) > 1:
+                keep = max(1, int(len(chunks) * behavior.keep_fraction))
+                chunks = chunks[:keep]
+            for chunk_hex in chunks:
+                raw = spec.enc_resp(chunk_hex)
+                stream.write(bytes([rpc_mod.RESULT_SUCCESS]))
+                if spec.context_bytes:
+                    stream.write(raw[:4])
+                    rpc_mod.write_payload(stream, raw[4:])
+                else:
+                    rpc_mod.write_payload(stream, raw)
+                if behavior.kind == "trickle" and behavior.chunk_delay > 0:
+                    _interruptible_sleep(peer, stream,
+                                         behavior.chunk_delay)
+        elif spec.expect_response or resp:
+            stream.write(bytes([rpc_mod.RESULT_SUCCESS]))
+            rpc_mod.write_payload(stream, spec.enc_resp(resp))
+        stream.close()
+    except Exception:
+        pass        # client hung up mid-lie; nothing to clean
+
+
 class FaultyTransport(Transport):
     """Transport with every fault choke point routed through a
     FaultInjector.  Constructed exactly like Transport plus
@@ -234,6 +411,37 @@ class FaultyTransport(Transport):
         self.injector = injector
         self.label = label
         injector.register(label, self)
+
+    # `on_rpc_stream` is a plain attribute on Transport (assigned in
+    # __init__, later overwritten by RpcHandler with its bound
+    # serve_stream).  Making it a data descriptor here intercepts BOTH
+    # assignments, so every inbound req/resp stream can be routed through
+    # the injector's behavior table without RpcHandler knowing.
+    @property
+    def on_rpc_stream(self):
+        raw = self._raw_on_rpc_stream
+        injector = getattr(self, "injector", None)
+        if injector is None:        # mid-super().__init__, before wiring
+            return raw
+
+        def serve(peer, protocol_id, stream):
+            dst = injector.label_of(peer.node_id)
+            behavior = injector.behavior(self.label, dst)
+            spec = rpc_mod.BY_ID.get(protocol_id)
+            if behavior is None or spec is None \
+                    or spec.name not in behavior.protocols:
+                raw(peer, protocol_id, stream)
+                return
+            injector.note_behavior(behavior.kind)
+            _serve_byzantine(raw, behavior, peer, spec, stream)
+
+        return serve
+
+    @on_rpc_stream.setter
+    def on_rpc_stream(self, fn) -> None:
+        # runs during Transport.__init__ (default lambda) before
+        # self.injector exists — must not touch injector state
+        self._raw_on_rpc_stream = fn
 
     def dial(self, host: str, port: int):
         if self.injector.refuse_dial(self.label, host, port):

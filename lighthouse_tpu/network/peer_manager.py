@@ -9,8 +9,10 @@ from dataclasses import dataclass, field
 
 def _metrics():
     """metrics_defs, sys.modules-gated (wire tests run the network layer
-    without the metrics stack)."""
-    return sys.modules.get("lighthouse_tpu.api.metrics_defs")
+    without the metrics stack); a module still mid-import reads as
+    absent so racing network threads never see a half-built module."""
+    md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+    return md if hasattr(md, "count") and hasattr(md, "gauge") else None
 
 
 @dataclass
@@ -29,9 +31,16 @@ class PeerManager:
     # honest connection drift toward the ban threshold, since aggregates
     # routinely cover already-seen attestations.  Only REJECT (provably
     # invalid) and protocol abuse carry weight.
+    # Sync failure reasons carry distinct weights (ISSUE 11 satellite):
+    # a peer that *disconnected* mid-request is barely at fault
+    # (peer_gone), a stalled request is protocol abuse lighter than junk
+    # (stall), and a payload we could not even decode is near-certain
+    # malice (decode_error).  "shutdown" is OUR close path and must never
+    # reach report() — machines skip the penalty entirely.
     SCORES = {"reject": -5.0, "ignore": 0.0, "accept": 0.1,
               "rate_limited": -1.0, "timeout": -2.0, "bad_segment": -10.0,
-              "empty_batch": -3.0}
+              "empty_batch": -3.0, "peer_gone": -0.5, "stall": -3.0,
+              "decode_error": -6.0, "truncated_batch": -6.0}
 
     def __init__(self, target_peers: int = 16):
         self.peers: dict[str, PeerInfo] = {}

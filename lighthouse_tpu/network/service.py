@@ -207,6 +207,10 @@ class NetworkService:
     def _on_disconnect(self, peer) -> None:
         self.peers.on_disconnect(peer.node_id)
         self.gossip.on_peer_disconnected(peer.node_id)
+        # drop the peer from range-sync chain pools too: a banned or
+        # vanished peer left in a pool burns a download attempt per
+        # batch on guaranteed "peer gone" failures (ISSUE 11)
+        self.sync.range.remove_peer(peer.node_id)
 
     def _ban(self, node_id: str) -> None:
         peer = self.transport.peers.get(node_id)

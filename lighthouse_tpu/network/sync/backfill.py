@@ -17,7 +17,17 @@ rotates peers on the next drive).
 """
 from __future__ import annotations
 
+import sys
+
 from .batches import Batch, BatchState
+from .validation import validate_range_batch
+
+
+def _count(name: str, amount: float = 1) -> None:
+    md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+    count = getattr(md, "count", None)
+    if count is not None:
+        count(name, amount)
 
 
 class BackfillSync:
@@ -77,7 +87,8 @@ class BackfillSync:
             busy = {b.peer for b in self.batches.values()
                     if b.state == BatchState.DOWNLOADING}
             pool = [p for p in peers if p not in busy]
-            peer = batch.pick_peer(pool)
+            peer = batch.pick_peer(
+                pool, salt=batch.download_attempts + batch.id)
             if peer is None:
                 return
             req_id = self.ctx.send_range(peer, batch.start_slot, batch.count,
@@ -87,13 +98,29 @@ class BackfillSync:
 
     # -- events --------------------------------------------------------------
 
-    def on_range_response(self, req_id: int, blocks: list | None) -> None:
+    def on_range_response(self, req_id: int, blocks: list | None,
+                          reason: str = "timeout") -> None:
         bid = self.requests.pop(req_id, None)
         if bid is None:
             return
         batch = self.batches[bid]
         if blocks is None:
-            self.ctx.penalize(batch.peer, "timeout")
+            if reason != "shutdown":        # our close path: no penalty
+                self.ctx.penalize(batch.peer, reason)
+            if batch.download_failed() == BatchState.FAILED:
+                self.stopped = True
+            return
+        # download-time structural validation: a wrong-range / reordered
+        # / miscounted response never reaches the anchor-linkage stage
+        # (which could otherwise mis-advance the anchor on junk)
+        res = validate_range_batch(blocks, batch.start_slot, batch.count,
+                                   block_root=self.ctx.block_root)
+        if not res.ok:
+            _count("sync_batch_validation_rejects_total")
+            note = getattr(self.ctx, "note_validation_reject", None)
+            if note is not None:
+                note(batch.peer, batch.start_slot, batch.count, res.reason)
+            self.ctx.penalize(batch.peer, "bad_segment")
             if batch.download_failed() == BatchState.FAILED:
                 self.stopped = True
             return
@@ -172,6 +199,7 @@ class BackfillSync:
                     self.stopped = True
                     return
             batch.processed()
+            _count("sync_backfill_batches_total")
             self.process_ptr += 1
 
     def _rewindow(self) -> None:

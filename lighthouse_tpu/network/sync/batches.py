@@ -91,13 +91,16 @@ class Batch:
 
     # -- helpers -------------------------------------------------------------
 
-    def pick_peer(self, pool: list[str]) -> str | None:
+    def pick_peer(self, pool: list[str], salt: int = 0) -> str | None:
         """Prefer a pool peer that has never touched this batch; fall back
-        to any pool peer (the batch may outlive fresh peers)."""
+        to any pool peer (the batch may outlive fresh peers).  `salt`
+        (seeded on attempt count + batch id by callers) rotates the pick
+        so a deterministic `pool[0]` can't retry the same failed peer
+        forever."""
         fresh = [p for p in pool if p not in self.attempted_peers]
         if fresh:
-            return fresh[0]
-        return pool[0] if pool else None
+            return fresh[salt % len(fresh)]
+        return pool[salt % len(pool)] if pool else None
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (f"Batch({self.id}, slots=[{self.start_slot},"

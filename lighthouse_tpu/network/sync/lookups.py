@@ -18,6 +18,15 @@ accumulated segment oldest-first.  Guarantees mirrored from the reference:
 """
 from __future__ import annotations
 
+import sys
+
+
+def _count(name: str, amount: float = 1) -> None:
+    md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+    count = getattr(md, "count", None)
+    if count is not None:
+        count(name, amount)
+
 
 class Lookup:
     MAX_ATTEMPTS = 4
@@ -35,11 +44,13 @@ class Lookup:
         self.depth_limit = depth_limit
 
     def pick_peer(self) -> str | None:
+        """Rotate by attempt count so exhausted-pool retries walk the
+        pool instead of hammering the same (possibly failed) peer."""
         fresh = sorted(self.peers - self.served_by)
         if fresh:
-            return fresh[0]
+            return fresh[self.attempts % len(fresh)]
         pool = sorted(self.peers)
-        return pool[0] if pool else None
+        return pool[self.attempts % len(pool)] if pool else None
 
 
 class BlockLookups:
@@ -70,6 +81,7 @@ class BlockLookups:
         lk = Lookup(self._next_id, root, peer_id, depth_limit=max_depth)
         self._next_id += 1
         self.lookups[lk.id] = lk
+        _count("sync_parent_lookups_total")
         self._request(lk)
 
     def _request(self, lk: Lookup) -> None:
@@ -85,8 +97,11 @@ class BlockLookups:
 
     # -- events --------------------------------------------------------------
 
-    def on_root_response(self, req_id: int, block, peer_id: str) -> None:
-        """block=None means error/timeout/empty — rotate peers."""
+    def on_root_response(self, req_id: int, block, peer_id: str,
+                         reason: str = "timeout") -> None:
+        """block=None means error/timeout/empty — rotate peers.  `reason`
+        distinguishes peer_gone / decode_error / stall (distinct penalty
+        weights) and "shutdown" (our close path: no penalty, no retry)."""
         lid = self.requests.pop(req_id, None)
         if lid is None:
             return
@@ -95,7 +110,10 @@ class BlockLookups:
             return
         lk.req_id = None
         if block is None:
-            self.ctx.penalize(peer_id, "timeout")
+            if reason == "shutdown":
+                self.lookups.pop(lk.id, None)
+                return
+            self.ctx.penalize(peer_id, reason)
             self._request(lk)
             return
         if self.ctx.block_root(block) != lk.awaiting:

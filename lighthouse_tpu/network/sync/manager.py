@@ -16,7 +16,11 @@ simulator drive them synchronously): `maybe_sync()`, `backfill()`,
 """
 from __future__ import annotations
 
+import random
+import sys
 import threading
+import time
+from collections import deque
 from concurrent.futures import (
     FIRST_COMPLETED, Future, ThreadPoolExecutor, wait,
 )
@@ -28,6 +32,102 @@ from .lookups import BlockLookups
 from .range_sync import EPOCHS_PER_BATCH, RangeSync
 
 REQUEST_TIMEOUT = 20.0
+
+
+def _metrics():
+    """metrics_defs, sys.modules-gated (the sync machines run in wire
+    tests without the metrics stack loaded).  A module that is still
+    mid-import — sync threads can race the api package's first import —
+    is treated as absent rather than letting an AttributeError escape
+    into the status/pump threads."""
+    md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+    return md if hasattr(md, "count") and hasattr(md, "gauge") else None
+
+
+class _DecodeError(Exception):
+    """A response chunk failed SSZ/fork-digest decoding — near-certain
+    peer malice, attributed separately from a timeout."""
+
+
+class PeerBackoff:
+    """Jittered exponential re-dispatch backoff + per-peer quarantine.
+
+    Every failed request charges the serving peer a growing, jittered
+    delay before sync will dispatch to it again; QUARANTINE_AFTER
+    consecutive failures quarantines the peer outright for
+    QUARANTINE_SECS (`maybe_sync`/`backfill` skip quarantined peers when
+    building pools).  Any success clears the slate.  Seeded RNG keeps
+    scenarios deterministic.
+    """
+
+    BASE_DELAY = 0.5
+    MAX_DELAY = 8.0
+    QUARANTINE_AFTER = 3
+    QUARANTINE_SECS = 30.0
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._fails: dict[str, int] = {}
+        self._delay_until: dict[str, float] = {}
+        self._quarantine_until: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def note_failure(self, peer_id: str) -> float:
+        """Record a failed request; returns the backoff delay applied."""
+        quarantined = False
+        with self._lock:
+            n = self._fails.get(peer_id, 0) + 1
+            self._fails[peer_id] = n
+            delay = min(self.MAX_DELAY, self.BASE_DELAY * 2 ** (n - 1))
+            delay *= 0.5 + self._rng.random()
+            self._delay_until[peer_id] = time.monotonic() + delay
+            if n == self.QUARANTINE_AFTER:
+                self._quarantine_until[peer_id] = (
+                    time.monotonic() + self.QUARANTINE_SECS)
+                quarantined = True
+        if quarantined:
+            md = _metrics()
+            if md is not None:
+                md.count("sync_peer_quarantined_total")
+        return delay
+
+    def note_success(self, peer_id: str) -> None:
+        with self._lock:
+            self._fails.pop(peer_id, None)
+            self._delay_until.pop(peer_id, None)
+            self._quarantine_until.pop(peer_id, None)
+
+    def quarantined(self, peer_id: str) -> bool:
+        with self._lock:
+            until = self._quarantine_until.get(peer_id)
+            if until is None:
+                return False
+            if time.monotonic() >= until:
+                del self._quarantine_until[peer_id]
+                return False
+            return True
+
+    def delay_remaining(self, peer_id: str) -> float:
+        with self._lock:
+            until = self._delay_until.get(peer_id)
+        if until is None:
+            return 0.0
+        return max(0.0, until - time.monotonic())
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "failing": dict(self._fails),
+                "backoff_remaining": {
+                    p: round(max(0.0, t - now), 3)
+                    for p, t in self._delay_until.items()
+                    if t > now},
+                "quarantined": {
+                    p: round(max(0.0, t - now), 3)
+                    for p, t in self._quarantine_until.items()
+                    if t > now},
+            }
 
 
 class _RealSyncContext:
@@ -43,10 +143,16 @@ class _RealSyncContext:
         self._next_req = 0
         self._pool = None
         self._closed = False
-        # req_id -> (owner, peer_id, future, kind)
+        # req_id -> (owner, peer_id, future, kind, deadline)
         self.inflight: dict[int, tuple] = {}
         self.imported_total = 0
         self._lock = threading.Lock()
+        # per-request deadline; instance attr so scenarios can tighten it
+        self.request_timeout = REQUEST_TIMEOUT
+        self.backoff = PeerBackoff()
+        # newest-last (peer, start, count, reason) validation rejects,
+        # surfaced by the flight recorder's doc["sync"] section
+        self.validation_rejects: deque = deque(maxlen=32)
 
     # -- chain views ---------------------------------------------------------
 
@@ -77,7 +183,19 @@ class _RealSyncContext:
         return n, None
 
     def penalize(self, peer_id: str, reason: str) -> None:
+        if reason == "shutdown":
+            return                      # our own close path, not the peer's
+        md = _metrics()
+        if md is not None:
+            md.count("sync_penalties_total")
+            md.count(f"sync_penalties_total_{reason}")
         self.peers.report(peer_id, reason)
+
+    def note_validation_reject(self, peer_id: str, start: int, count: int,
+                               reason: str) -> None:
+        self.validation_rejects.append(
+            {"peer": peer_id, "start": start, "count": count,
+             "reason": reason})
 
     def finalized_slot(self) -> int:
         fin_epoch = int(self.chain.fork_choice.finalized_checkpoint[0])
@@ -141,7 +259,7 @@ class _RealSyncContext:
             fut.set_exception(TimeoutError("sync context closed"))
             return fut
 
-    def _decode_block(self, hex_payload: str):
+    def _decode_block(self, hex_payload: str, strict: bool = False):
         try:
             raw = bytes.fromhex(hex_payload)
             dmap = self._digest_map
@@ -150,26 +268,52 @@ class _RealSyncContext:
             cls = self.chain.T.SignedBeaconBlock[dmap[raw[:4]]]
             return deserialize(cls.ssz_type, raw[4:])
         except Exception:
+            # an undecodable chunk must not masquerade as an empty
+            # response (the pre-ISSUE-11 behavior): the fetcher raises so
+            # the pump attributes "decode_error" to the serving peer
+            if strict:
+                raise _DecodeError(hex_payload[:16])
             return None
 
+    def _pace(self, peer_id: str) -> None:
+        """Honor this peer's backoff delay inside the worker thread (never
+        under a lock); bails out promptly if the context closes."""
+        end = time.monotonic() + self.backoff.delay_remaining(peer_id)
+        while True:
+            left = end - time.monotonic()
+            if left <= 0:
+                return
+            if self._closed:
+                raise TimeoutError("sync context closed")
+            time.sleep(min(0.1, left))
+
     def _fetch_range(self, peer_id: str, start: int, count: int):
+        self._pace(peer_id)
         peer = self.rpc.transport.peers.get(peer_id)
         if peer is None:
             raise TimeoutError("peer gone")
         resp = self.rpc.request(peer, "beacon_blocks_by_range",
-                                {"start_slot": start, "count": count})
-        blocks = [self._decode_block(b) for b in resp or []]
-        return [b for b in blocks if b is not None]
+                                {"start_slot": start, "count": count},
+                                timeout=self.request_timeout)
+        return [self._decode_block(b, strict=True) for b in resp or []]
 
     def _fetch_root(self, peer_id: str, root: bytes):
+        self._pace(peer_id)
         peer = self.rpc.transport.peers.get(peer_id)
         if peer is None:
             raise TimeoutError("peer gone")
         resp = self.rpc.request(peer, "beacon_blocks_by_root",
-                                {"roots": [root.hex()]})
+                                {"roots": [root.hex()]},
+                                timeout=self.request_timeout)
         if not resp:
             return None
-        return self._decode_block(resp[0])
+        return self._decode_block(resp[0], strict=True)
+
+    def _deadline(self, peer_id: str) -> float:
+        # the deadline covers the request's own budget PLUS whatever
+        # backoff pause the worker will sit out first
+        return (time.monotonic() + self.request_timeout
+                + self.backoff.delay_remaining(peer_id))
 
     def send_range(self, peer_id: str, start: int, count: int, owner) -> int:
         # submit BEFORE taking the lock (submission takes it internally),
@@ -182,7 +326,8 @@ class _RealSyncContext:
         with self._lock:
             req_id = self._next_req
             self._next_req += 1
-            self.inflight[req_id] = (owner, peer_id, fut, "range")
+            self.inflight[req_id] = (owner, peer_id, fut, "range",
+                                     self._deadline(peer_id))
         return req_id
 
     def send_root(self, peer_id: str, root: bytes, owner) -> int:
@@ -190,32 +335,98 @@ class _RealSyncContext:
         with self._lock:
             req_id = self._next_req
             self._next_req += 1
-            self.inflight[req_id] = (owner, peer_id, fut, "root")
+            self.inflight[req_id] = (owner, peer_id, fut, "root",
+                                     self._deadline(peer_id))
         return req_id
 
     # -- event pump ----------------------------------------------------------
 
+    @staticmethod
+    def _classify(fut) -> tuple[object, str]:
+        """(result, failure-reason) for a completed future.  The reason
+        only matters when result is None; "shutdown" carries no penalty,
+        the rest map to distinct peer_manager SCORES weights."""
+        try:
+            return fut.result(timeout=0), "timeout"
+        except _DecodeError:
+            return None, "decode_error"
+        except TimeoutError as exc:
+            msg = str(exc)
+            if msg == "peer gone":
+                return None, "peer_gone"
+            if msg == "sync context closed":
+                return None, "shutdown"
+            return None, "timeout"
+        except Exception:
+            return None, "timeout"
+
     def pump(self) -> None:
         """Deliver completed request results to their owners until no
-        request is in flight.  A stalled 20 s window fails everything
-        outstanding (download timeout semantics)."""
-        while self.inflight:
-            futs = {rec[2]: rid for rid, rec in self.inflight.items()}
-            done, _ = wait(list(futs), timeout=REQUEST_TIMEOUT,
+        request is in flight.
+
+        Per-request deadline wheel (ISSUE 11): each in-flight request
+        carries its own deadline; the pump waits only until the nearest
+        one, then expires overdue requests *individually* — failing that
+        request alone and penalizing that peer alone.  A slowloris peer
+        can no longer mass-fail the honest pool the way the old global
+        20 s stall window did (`sync_pump_global_stall_total` is the
+        structurally-zero tripwire for that behavior).
+        """
+        while True:
+            with self._lock:
+                if not self.inflight:
+                    return
+                futs = {rec[2]: rid for rid, rec in self.inflight.items()}
+                nearest = min(rec[4] for rec in self.inflight.values())
+            done, _ = wait(list(futs),
+                           timeout=max(0.0, nearest - time.monotonic()),
                            return_when=FIRST_COMPLETED)
-            if not done:
-                done = set(futs)            # global stall: fail them all
-            for fut in done:
-                rid = futs[fut]
-                owner, peer_id, _f, kind = self.inflight.pop(rid)
-                try:
-                    result = fut.result(timeout=0)
-                except Exception:
-                    result = None
-                if kind == "range":
-                    owner.on_range_response(rid, result)
+            now = time.monotonic()
+            deliveries = []                 # (rid, record, expired)
+            with self._lock:
+                for fut in done:
+                    rec = self.inflight.pop(futs[fut], None)
+                    if rec is not None:
+                        deliveries.append((futs[fut], rec, False))
+                for rid, rec in list(self.inflight.items()):
+                    if rec[4] <= now:
+                        del self.inflight[rid]
+                        deliveries.append((rid, rec, True))
+            md = _metrics()
+            for rid, (owner, peer_id, fut, kind, _dl), expired in deliveries:
+                if expired:
+                    fut.cancel()
+                    if md is not None:
+                        md.count("sync_request_deadline_expired_total")
+                    result, reason = None, "stall"
                 else:
-                    owner.on_root_response(rid, result, peer_id)
+                    result, reason = self._classify(fut)
+                if result is None and reason != "shutdown":
+                    self.backoff.note_failure(peer_id)
+                elif result is not None:
+                    self.backoff.note_success(peer_id)
+                if kind == "range":
+                    owner.on_range_response(rid, result, reason=reason)
+                else:
+                    owner.on_root_response(rid, result, peer_id,
+                                           reason=reason)
+
+    def snapshot(self) -> dict:
+        """Flight-recorder view: in-flight requests, backoff/quarantine
+        state, and the most recent validation rejects."""
+        now = time.monotonic()
+        with self._lock:
+            inflight = [
+                {"req_id": rid, "peer": rec[1], "kind": rec[3],
+                 "deadline_in": round(rec[4] - now, 3)}
+                for rid, rec in self.inflight.items()]
+        return {
+            "inflight": inflight,
+            "backoff": self.backoff.snapshot(),
+            "validation_rejects": list(self.validation_rejects),
+            "imported_total": self.imported_total,
+            "request_timeout": self.request_timeout,
+        }
 
 
 class SyncManager:
@@ -245,8 +456,7 @@ class SyncManager:
     @state.setter
     def state(self, value: str) -> None:
         self._state = value
-        import sys
-        md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+        md = _metrics()
         if md is not None:
             md.gauge("sync_state", 0 if value == "synced" else 1)
 
@@ -268,7 +478,8 @@ class SyncManager:
                 # completes, still-ahead peers regroup into head chains
                 # (chain_collection.rs re-grouping)
                 for p in self.peers.connected():
-                    if p.status is not None and p.score >= 0:
+                    if (p.status is not None and p.score >= 0
+                            and not self.ctx.backoff.quarantined(p.node_id)):
                         self.range.add_peer(p.node_id, p.status)
                 chain = self.range.drive()
                 if chain is None or not self.ctx.inflight:
@@ -285,7 +496,8 @@ class SyncManager:
             machine = BackfillSync(self.ctx, batch_slots)
             pool = [p.node_id for p in self.peers.connected()
                     if p.status is not None and p.score >= 0
-                    and not p.banned]
+                    and not p.banned
+                    and not self.ctx.backoff.quarantined(p.node_id)]
             if not pool:
                 best = self.peers.best_peer_for_sync()
                 if best is None:
@@ -299,6 +511,12 @@ class SyncManager:
             return machine.stored
 
     # -- helpers (round-3 compatible) ----------------------------------------
+
+    def snapshot(self) -> dict:
+        """Sync-layer view for the flight recorder's doc["sync"]."""
+        snap = self.ctx.snapshot()
+        snap["state"] = self.state
+        return snap
 
     def _decode_block(self, hex_payload: str):
         return self.ctx._decode_block(hex_payload)

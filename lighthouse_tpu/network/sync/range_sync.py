@@ -18,14 +18,31 @@ sync tests (network/src/sync/block_lookups/tests.rs style).
 """
 from __future__ import annotations
 
+import sys
+
 from ...chain.errors import PARENT_UNKNOWN
 from .batches import Batch, BatchState
+from .validation import validate_range_batch
 
 EPOCHS_PER_BATCH = 2
 
 
+def _count(name: str, amount: float = 1) -> None:
+    """Catalog counter, sys.modules-gated (synthetic-event tests drive
+    the machines without the metrics stack).  getattr-guarded so a
+    module still mid-import reads as absent."""
+    md = sys.modules.get("lighthouse_tpu.api.metrics_defs")
+    count = getattr(md, "count", None)
+    if count is not None:
+        count(name, amount)
+
+
 class SyncingChain:
     BATCH_BUFFER = 5          # in-flight batches beyond the processing head
+    # a pool whose every batch comes back empty while nothing imports is
+    # lying about its target (a fake-ahead STATUS): fail fast instead of
+    # walking millions of empty slots toward a fabricated head
+    MAX_CONSEC_EMPTY = 8
 
     def __init__(self, chain_id: int, kind: str, target_root: bytes,
                  target_slot: int, start_slot: int, batch_slots: int,
@@ -47,6 +64,11 @@ class SyncingChain:
         self.complete = False
         # req_id -> batch_id for in-flight downloads
         self.requests: dict[int, int] = {}
+        self._consec_empty = 0
+        # batch_id -> root of the last *processed* block at/below that
+        # batch's end (empty batches inherit the tail below them); feeds
+        # the download-time continuity check
+        self._tail_roots: dict[int, bytes] = {}
 
     # -- pool ----------------------------------------------------------------
 
@@ -92,14 +114,17 @@ class SyncingChain:
                 continue
             pool = self.available_peers
             fresh = [p for p in pool if p not in batch.attempted_peers]
+            # rotate seeded on (attempt, batch id) so a deterministic
+            # fresh[0] can't hand every retry to the same failed peer
+            salt = batch.download_attempts + batch.id
             if fresh:
-                peer = fresh[0]
+                peer = fresh[salt % len(fresh)]
             elif self.peers - batch.attempted_peers:
                 continue                    # a fresh peer exists but is busy:
                                             # defer rather than re-ask a
                                             # peer that already failed this
             else:
-                peer = batch.pick_peer(pool)
+                peer = batch.pick_peer(pool, salt=salt)
                 if peer is None:
                     return                  # no free peers right now
             req_id = ctx.send_range(peer, batch.start_slot, batch.count, self)
@@ -109,22 +134,76 @@ class SyncingChain:
     # -- event handlers ------------------------------------------------------
 
     def on_range_response(self, req_id: int, blocks: list | None,
-                          ctx=None) -> None:
-        """blocks=None means the download failed (error/timeout/decode)."""
+                          ctx=None, reason: str = "timeout") -> None:
+        """blocks=None means the download failed; `reason` says why
+        (timeout/stall/peer_gone/decode_error/shutdown) and picks the
+        penalty weight — "shutdown" is our own close path and carries
+        none."""
         ctx = ctx if ctx is not None else self.ctx
         bid = self.requests.pop(req_id, None)
         if bid is None:
             return                          # stale response for a dropped req
         batch = self.batches[bid]
         if blocks is None:
-            ctx.penalize(batch.peer, "timeout")
+            ctx.penalize(batch.peer, reason)
             if batch.download_failed() == BatchState.FAILED:
                 self._fail(ctx)
                 return
+        elif not self._validate_download(ctx, batch, blocks):
+            return
         else:
+            _count("sync_range_batches_downloaded_total")
             batch.downloaded(blocks)
         self._process_ready(ctx)
         self.request_batches(ctx)
+
+    def _validate_download(self, ctx, batch, blocks) -> bool:
+        """Download-time structural validation (validation.py): a junk /
+        wrong-range / miscounted response is charged `bad_segment` in
+        O(batch) and never reaches process_segment.  A continuity break
+        against an already-processed previous batch is the *previous*
+        batch's truncated tail (this response already proved internally
+        linked): roll that batch back instead of blaming this peer.
+        Returns True when the caller should accept the download."""
+        prev_tail = self._tail_roots.get(batch.id - 1)
+        res = validate_range_batch(
+            blocks, batch.start_slot, batch.count,
+            block_root=ctx.block_root, prev_tail_root=prev_tail)
+        if res.ok:
+            return True
+        note = getattr(ctx, "note_validation_reject", None)
+        if res.reason == "continuity" and batch.id > 0:
+            prev = self.batches.get(batch.id - 1)
+            if (prev is not None and prev.state == BatchState.PROCESSED
+                    and prev.peer is not None):
+                if note is not None:
+                    note(prev.peer, prev.start_slot, prev.count,
+                         "continuity")
+                ctx.penalize(prev.peer, "truncated_batch")
+                self._rollback_processed(prev)
+                _count("sync_range_batches_downloaded_total")
+                batch.downloaded(blocks)    # this response stands
+                self.request_batches(ctx)
+                return False
+        _count("sync_batch_validation_rejects_total")
+        if note is not None:
+            note(batch.peer, batch.start_slot, batch.count, res.reason)
+        ctx.penalize(batch.peer, "bad_segment")
+        if batch.download_failed() == BatchState.FAILED:
+            self._fail(ctx)
+            return False
+        self.request_batches(ctx)
+        return False
+
+    def _rollback_processed(self, prev: Batch) -> None:
+        """Re-download an already-processed batch whose tail proved
+        truncated, preserving its attempt bookkeeping."""
+        redo = Batch(prev.id, prev.start_slot, prev.count)
+        redo.processing_attempts = prev.processing_attempts
+        redo.attempted_peers = set(prev.attempted_peers)
+        self.batches[prev.id] = redo
+        self._tail_roots.pop(prev.id, None)
+        self.process_ptr = min(self.process_ptr, prev.id)
 
     def _process_ready(self, ctx) -> None:
         """Import batches strictly in order while the frontier is ready."""
@@ -136,20 +215,40 @@ class SyncingChain:
             imported, err = ctx.process_segment(blocks) if blocks else (0, None)
             if err is None:
                 self.imported += imported
+                if imported:
+                    _count("sync_range_blocks_imported_total", imported)
+                if blocks:
+                    self._consec_empty = 0
+                    self._tail_roots[batch.id] = ctx.block_root(blocks[-1])
+                else:
+                    self._consec_empty += 1
+                    tail = self._tail_roots.get(batch.id - 1)
+                    if tail is not None:
+                        self._tail_roots[batch.id] = tail
                 batch.processed()
                 self.process_ptr += 1
+                if (self.imported == 0
+                        and self._consec_empty >= self.MAX_CONSEC_EMPTY):
+                    # every batch empty, nothing imported: the pool's
+                    # claimed target is a fabrication (lying STATUS) —
+                    # fail fast instead of draining it to the fake head
+                    self.failed = True
+                    for p in sorted(self.peers):
+                        ctx.penalize(p, "empty_batch")
+                    return
                 if self.process_ptr >= self._total_batches():
                     self._finish(ctx)
                     return
             elif err == PARENT_UNKNOWN and self.process_ptr > 0:
-                # the gap is the PREVIOUS batch's fault (a truncated tail
-                # is undetectable at download time): roll back and
-                # re-download batch k-1, don't blame this batch's peer
+                # download-time validation proved this batch internally
+                # linked and in-range, so an unknown parent at its head
+                # pins the gap on the PREVIOUS batch's truncated tail:
+                # roll back and re-download batch k-1 with precise blame
                 # (range_sync/chain.rs re-downloads the prior batch; the
-                # round-3 sync kept the same attribution)
+                # round-3 sync penalized "ignore" for want of evidence)
                 prev = self.batches[self.process_ptr - 1]
                 if prev.peer is not None:
-                    ctx.penalize(prev.peer, "ignore")
+                    ctx.penalize(prev.peer, "truncated_batch")
                 if prev.processing_attempts >= Batch.MAX_PROCESSING_ATTEMPTS:
                     self._fail(ctx)
                     return
@@ -157,6 +256,7 @@ class SyncingChain:
                 redo.processing_attempts = prev.processing_attempts
                 redo.attempted_peers = set(prev.attempted_peers)
                 self.batches[prev.id] = redo
+                self._tail_roots.pop(prev.id, None)
                 batch.state = BatchState.AWAITING_PROCESSING  # retry after
                 self.process_ptr -= 1
                 self.request_batches(ctx)
@@ -199,7 +299,13 @@ class RangeSync:
     def __init__(self, ctx, batch_slots: int | None = None):
         self.ctx = ctx
         self.chains: dict[tuple, SyncingChain] = {}
-        self.retired: set[tuple] = set()   # completed/failed targets
+        self.retired: set[tuple] = set()   # completed targets
+        # failed target -> the pool that failed it.  A FAILED target is
+        # only dead to the peers that failed to serve it: a byzantine
+        # pool must not be able to poison a real target for honest peers
+        # that show up later (ISSUE 11).  Completed targets stay retired
+        # for everyone — a stale STATUS can't resurrect them.
+        self.failed_from: dict[tuple, set[str]] = {}
         self._next_chain_id = 0
         self.batch_slots = batch_slots or (
             EPOCHS_PER_BATCH * ctx.slots_per_epoch())
@@ -223,7 +329,22 @@ class RangeSync:
         for key in candidates:
             if key in self.retired or key[2] <= local_head:
                 continue
+            if peer_id in self.failed_from.get(key, ()):
+                continue   # this peer already failed to serve this target
             chain = self.chains.get(key)
+            if chain is not None and (chain.failed or chain.complete):
+                # purge hasn't run yet — retire the dead chain here so
+                # the new peer never lands in a failed pool's blame set
+                if chain.complete:
+                    self.retired.add(key)
+                else:
+                    self.failed_from.setdefault(key, set()) \
+                        .update(chain.peers)
+                del self.chains[key]
+                if key in self.retired \
+                        or peer_id in self.failed_from.get(key, ()):
+                    continue
+                chain = None
             if chain is None:
                 chain = SyncingChain(
                     self._next_chain_id, key[0], key[1], key[2],
@@ -242,10 +363,14 @@ class RangeSync:
 
     def best_chain(self) -> SyncingChain | None:
         """Finalized chains beat head chains; more peers beats fewer —
-        purging dead chains first (their targets are retired so a stale
-        STATUS can't resurrect them)."""
-        self.retired |= {k for k, c in self.chains.items()
-                         if c.failed or c.complete}
+        purging dead chains first.  Completed targets are retired for
+        everyone (a stale STATUS can't resurrect them); failed targets
+        are retired only from the pool that failed them, so honest
+        peers arriving later can still serve the same target."""
+        self.retired |= {k for k, c in self.chains.items() if c.complete}
+        for k, c in self.chains.items():
+            if c.failed and not c.complete:
+                self.failed_from.setdefault(k, set()).update(c.peers)
         self.chains = {k: c for k, c in self.chains.items()
                        if not c.failed and not c.complete and c.peers}
         ranked = sorted(
@@ -260,10 +385,12 @@ class RangeSync:
             chain.request_batches(self.ctx)
         return chain
 
-    def on_range_response(self, req_id: int, blocks: list | None) -> None:
+    def on_range_response(self, req_id: int, blocks: list | None,
+                          reason: str = "timeout") -> None:
         for chain in list(self.chains.values()):
             if req_id in chain.requests:
-                chain.on_range_response(req_id, blocks, self.ctx)
+                chain.on_range_response(req_id, blocks, self.ctx,
+                                        reason=reason)
                 return
 
     @property

@@ -79,6 +79,14 @@ _COSIGNALS = [
     ("beacon_block_imported_total", "delta", "blocks imported"),
     ("gossipsub_validation_reject_total", "delta",
      "gossip messages rejected"),
+    ("sync_range_blocks_imported_total", "delta",
+     "range-sync blocks imported"),
+    ("sync_batch_validation_rejects_total", "delta",
+     "sync batches rejected at download time"),
+    ("sync_request_deadline_expired_total", "delta",
+     "sync request deadlines expired"),
+    ("sync_peer_quarantined_total", "delta",
+     "sync peers quarantined"),
 ]
 
 
@@ -137,6 +145,7 @@ def diagnose(doc: dict) -> dict:
         "jax": doc.get("jax") or {},
         "chains": doc.get("chains") or [],
         "processors": doc.get("processors") or [],
+        "sync": doc.get("sync"),
         "recovery": doc.get("recovery"),
         "incidents": [_correlate_incident(i, slots, series)
                       for i in incidents],
@@ -180,6 +189,28 @@ def render(diag: dict) -> str:
                 f"  processor: {_fmt_num(pr.get('processed'))} processed, "
                 f"{_fmt_num(pr.get('dropped'))} dropped, "
                 f"high water {_fmt_num(pr.get('high_water'))}")
+    # dumps older than the sync section simply lack the key — render
+    # nothing rather than "not recorded" so golden reports stay stable
+    for sn in diag.get("sync") or []:
+        if not isinstance(sn, dict):
+            continue
+        if "error" in sn:
+            lines.append(f"  sync: <{sn['error']}>")
+            continue
+        backoff = sn.get("backoff") or {}
+        quarantined = backoff.get("quarantined") or {}
+        rejects = sn.get("validation_rejects") or []
+        lines.append(
+            f"  sync: {sn.get('state', '?')}, "
+            f"{len(sn.get('inflight') or [])} in flight, "
+            f"{_fmt_num(sn.get('imported_total'))} blocks imported, "
+            f"{len(rejects)} validation reject(s), "
+            f"{len(quarantined)} peer(s) quarantined")
+        for rj in rejects[-3:]:
+            lines.append(
+                f"    rejected: peer {rj.get('peer')} "
+                f"[{_fmt_num(rj.get('start'))},"
+                f"+{_fmt_num(rj.get('count'))}) — {rj.get('reason')}")
     rec = diag.get("recovery")
     if rec:
         repairs = rec.get("repairs") or []

@@ -13,6 +13,8 @@ offline:
 - ``jax_accounting.snapshot()`` (compiles, compile seconds, transfers)
 - beacon-processor queue depths / drop / high-water counts
 - a fork-choice head summary per registered chain
+- a sync summary per chain (state, in-flight request deadlines, peer
+  backoff/quarantine, recent download-validation rejects)
 - the trace-stamped ``log_buffer`` tail
 - every incident (open and resolved) plus current SLO status
 - the last store-recovery report (``chain.persistence.LAST_RECOVERY``),
@@ -88,6 +90,22 @@ def _chain_summary(chain) -> dict:
     return out
 
 
+def _sync_summary(chain) -> dict | None:
+    """SyncManager snapshot for one chain: state, in-flight requests
+    with their deadlines, per-peer backoff/quarantine, recent
+    validation rejects.  None when the chain has no network service
+    (store-less rigs, unit-test stubs) — the doctor treats a missing
+    section as 'not recorded'."""
+    try:
+        sync = getattr(getattr(chain, "network_service", None), "sync",
+                       None)
+        if sync is None:
+            return None
+        return sync.snapshot()
+    except Exception as exc:
+        return {"error": repr(exc)}
+
+
 def _processor_summary(proc) -> dict:
     out: dict = {}
     try:
@@ -138,11 +156,15 @@ class FlightRecorder:
             doc["chains"] = [_chain_summary(c) for c in w.chains()]
             doc["processors"] = [_processor_summary(p)
                                  for p in w.processors()]
+            sync = [s for s in (_sync_summary(c) for c in w.chains())
+                    if s is not None]
+            doc["sync"] = sync or None
         else:
             doc["incidents"] = []
             doc["slo"] = {}
             doc["chains"] = []
             doc["processors"] = []
+            doc["sync"] = None
         doc["recovery"] = _recovery_report()
         doc["log_tail"] = global_log_buffer().tail(LOG_TAIL)
         return _json_safe(doc)

@@ -27,6 +27,10 @@ hand:
                             serving; re-shuffle storms tank epoch time
 ``processor_shedding``      the beacon processor sheds no work at queue
                             capacity (floods intentionally breach this)
+``sync_progress``           while range-syncing (``sync_state`` gauge
+                            != 0) the node keeps importing blocks; a
+                            byzantine-majority peer pool may slow sync
+                            down but must never stop it (ISSUE 11)
 ==========================  ============================================
 """
 from __future__ import annotations
@@ -154,11 +158,41 @@ def _check_shuffle_hit_ratio(budget_ratio: float,
     return check
 
 
+def _check_sync_progress(floor_blocks: float, stall_slots: int) -> Check:
+    """Breach after `stall_slots` CONSECUTIVE syncing slots that import
+    fewer than `floor_blocks` blocks.  Single stalled slots are normal
+    (requests in flight, a backoff pause after a byzantine serve); a
+    run of them while still `sync_state != synced` means the deadline /
+    validation / quarantine machinery failed to route around bad peers.
+    """
+    stalled = {"n": 0}      # closure state: consecutive stalled slots
+
+    def check(ctx: EvalContext):
+        state = ctx.sampler.latest("sync_state")
+        if state is None or state == 0:
+            stalled["n"] = 0
+            return None, False, "not syncing"
+        delta = ctx.sampler.latest("sync_range_blocks_imported_total")
+        delta = 0.0 if delta is None else delta
+        if delta >= floor_blocks:
+            stalled["n"] = 0
+            return delta, False, \
+                f"{delta:.0f} blocks imported this slot"
+        stalled["n"] += 1
+        return delta, stalled["n"] >= stall_slots, (
+            f"syncing but {delta:.0f} blocks imported this slot "
+            f"({stalled['n']} consecutive below floor "
+            f"{floor_blocks:.0f})")
+    return check
+
+
 def default_slos(pipeline_p95_s: float = 5.0,
                  head_lag_slots: int = 1,
                  compile_warmup_slots: int = 8,
                  shuffle_hit_ratio: float = 0.5,
-                 shuffle_min_lookups: int = 20) -> list[SLO]:
+                 shuffle_min_lookups: int = 20,
+                 sync_floor_blocks: float = 1.0,
+                 sync_stall_slots: int = 3) -> list[SLO]:
     return [
         SLO("block_pipeline_p95", "beacon_block_pipeline_seconds",
             pipeline_p95_s,
@@ -187,6 +221,12 @@ def default_slos(pipeline_p95_s: float = 5.0,
             "high-water floods intentionally trip this",
             _check_counter_quiet("beacon_processor_work_dropped_total",
                                  "shed items", warmup_slots=0)),
+        SLO("sync_progress", "sync_range_blocks_imported_total",
+            sync_floor_blocks,
+            "while range-syncing the node keeps importing blocks every "
+            "slot; byzantine peers may slow sync but never stop it",
+            _check_sync_progress(sync_floor_blocks, sync_stall_slots),
+            resolve_after=2),
     ]
 
 

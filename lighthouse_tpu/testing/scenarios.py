@@ -27,11 +27,13 @@ import time
 from dataclasses import dataclass, field
 
 from ..api.metrics import counter_value
-from ..network.faults import FaultInjector
+from ..network.faults import FaultInjector, PeerBehavior
+from ..network.sync import range_sync as range_sync_mod
 from ..obs import doctor as flight_doctor
 from ..obs import graftwatch
 from ..obs.capture import ScenarioTrace, scenario_capture
 from ..specs import minimal_spec
+from ..ssz import htr
 from ..validator_client.byzantine import ByzantineValidatorClient
 from .simulator import CheckResult, LocalNetwork
 
@@ -69,7 +71,9 @@ class ScenarioResult:
 _REGISTRY: dict[str, object] = {}
 #: scenarios too long for tier-1; tests put these behind the slow marker
 SLOW_SCENARIOS = frozenset({"long_nonfinality",
-                            "checkpoint_sync_partition"})
+                            "checkpoint_sync_partition",
+                            "sync_byzantine_pool",
+                            "backfill_under_stall"})
 
 
 def scenario(name: str):
@@ -160,6 +164,19 @@ def _fork_slot(chain_a, chain_b) -> int:
             return 0
         root = bytes(blk.message.parent_root)
     return 0
+
+
+def _wait_statuses(node, node_ids, timeout: float = 8.0) -> bool:
+    """Block until `node` holds a STATUS for every peer in node_ids —
+    the connect-time exchange runs on background threads."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        infos = node.network.peers.peers
+        if all(infos.get(n) is not None and infos[n].status is not None
+               for n in node_ids):
+            return True
+        time.sleep(0.02)
+    return False
 
 
 # -- 1. slashable equivocation ------------------------------------------------
@@ -541,6 +558,381 @@ def scenario_checkpoint_sync_partition(seed: int = 0) -> ScenarioResult:
              chain_major.head().head_block_root,
              "synced node re-orged onto the majority chain")
         _envelope_checks(result, net, trace, max_head_lag=2)
+    finally:
+        net.stop()
+    return result
+
+
+# -- 6. byzantine range-sync pool ---------------------------------------------
+
+@scenario("sync_byzantine_pool")
+def scenario_sync_byzantine_pool(seed: int = 0) -> ScenarioResult:
+    """A fresh node range-syncs with 3 of its 5 serving peers byzantine
+    (one each stall / junk / truncate).  Per-request deadlines, the
+    download-time batch validator and precise truncation blame must
+    penalize each adversary below the ban threshold WITHOUT a single
+    rejected batch reaching process_chain_segment and without any
+    global pump stall, and the sync must then complete from the honest
+    peers that the failed byzantine pool must not be able to poison."""
+    result = ScenarioResult("sync_byzantine_pool", seed)
+    spec = minimal_spec(altair_fork_epoch=0)
+    spe = spec.preset.slots_per_epoch
+    injector = FaultInjector(seed)
+    # behaviors are label-keyed, so the victim n5's links can be rigged
+    # before it exists
+    injector.set_behavior("n0", "n5",
+                          PeerBehavior("stall", stall_secs=6.0))
+    injector.set_behavior("n1", "n5", PeerBehavior("junk"))
+    injector.set_behavior("n2", "n5",
+                          PeerBehavior("truncate", keep_fraction=0.5))
+    net = LocalNetwork(spec, 5, 40, topology="mesh", injector=injector)
+    watch = graftwatch.get()
+    rejects0 = counter_value("sync_batch_validation_rejects_total")
+    expired0 = counter_value("sync_request_deadline_expired_total")
+    gstall0 = counter_value("sync_pump_global_stall_total")
+    restore = []
+    try:
+        net.run_slots(3 * spe)               # history worth syncing
+        vi = net.add_fresh_node(dial=[])     # knobs first, dial after
+        victim = net.nodes[vi]
+        sync = victim.network.sync
+        peers = victim.network.peers
+        chain5 = victim.harness.chain
+        # scenario-speed knobs: tight deadlines and near-zero backoff so
+        # the stall adversary burns 0.75s per hit instead of 20s, small
+        # batches so every adversary serves several times.  Quarantine is
+        # disabled (scenario 7 exercises it): here the SCORE ledger alone
+        # must cross the ban line, which is lowered to what a pool-scoped
+        # penalty run can reach before the pool excludes negative peers.
+        sync.ctx.request_timeout = 0.75
+        bo = sync.ctx.backoff
+        bo.BASE_DELAY = 0.05
+        bo.MAX_DELAY = 0.2
+        bo.QUARANTINE_AFTER = 10 ** 6
+        sync.range.batch_slots = 2
+        peers.BAN_THRESHOLD = -8.0
+        # a banned peer disconnects and its PeerInfo is dropped, after
+        # which score() reads 0.0 — mirror the ledger here
+        tally: dict[str, float] = {}
+        real_report = peers.report
+
+        def tallied_report(node_id, event):
+            tally[node_id] = (tally.get(node_id, 0.0)
+                              + peers.SCORES.get(event, 0.0))
+            real_report(node_id, event)
+
+        peers.report = tallied_report
+        restore.append(lambda: setattr(peers, "report", real_report))
+        # reject spy: the exact list object a validation reject discarded
+        # must never reach process_chain_segment (the junk adversary
+        # serves REAL blocks from the wrong range, so root-based matching
+        # would false-positive on their later honest arrival)
+        real_validate = range_sync_mod.validate_range_batch
+        rejected_lists: list = []
+
+        def spying_validate(blocks, start, count, **kw):
+            res = real_validate(blocks, start, count, **kw)
+            if not res.ok and res.reason != "continuity" and blocks:
+                rejected_lists.append(blocks)
+            return res
+
+        range_sync_mod.validate_range_batch = spying_validate
+        restore.append(lambda: setattr(
+            range_sync_mod, "validate_range_batch", real_validate))
+        real_process = chain5.process_chain_segment
+        leaked: list = []
+
+        def guarded_process(blocks):
+            if any(blocks is r for r in rejected_lists):
+                leaked.append(len(blocks))
+            return real_process(blocks)
+
+        chain5.process_chain_segment = guarded_process
+        nid = [net.nodes[j].network.transport.node_id for j in range(5)]
+        with scenario_capture() as trace:
+            # phase A: only the three byzantine peers serve
+            for j in (0, 1, 2):
+                victim.network.dial("127.0.0.1",
+                                    net.nodes[j].network.port)
+            _wait_statuses(victim, nid[:3])
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline:
+                sync.maybe_sync()
+                if all(tally.get(n, 0.0) < peers.BAN_THRESHOLD
+                       for n in nid[:3]):
+                    break
+                time.sleep(0.05)
+            # phase B: honest peers arrive; the targets the byzantine
+            # pool failed must still be syncable from them
+            for j in (3, 4):
+                victim.network.dial("127.0.0.1",
+                                    net.nodes[j].network.port)
+            _wait_statuses(victim, nid[3:5])
+            target = net.nodes[3].harness.chain.head().head_block_root
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                sync.maybe_sync()
+                if chain5.head().head_block_root == target:
+                    break
+                time.sleep(0.05)
+            net.run_slots(spe)               # envelope traffic
+        result.trace = trace
+        _chk(result, "synced_from_honest",
+             chain5.head().head_block_root ==
+             net.nodes[3].harness.chain.head().head_block_root,
+             f"victim head at slot {chain5.head().head_state.slot} "
+             "matches the honest peers'")
+        for j, kind in ((0, "stall"), (1, "junk"), (2, "truncate")):
+            _chk(result, f"{kind}_peer_banned",
+                 tally.get(nid[j], 0.0) < peers.BAN_THRESHOLD,
+                 f"n{j} ({kind}) penalty ledger "
+                 f"{tally.get(nid[j], 0.0):.1f} < ban threshold "
+                 f"{peers.BAN_THRESHOLD}")
+        rejects = counter_value("sync_batch_validation_rejects_total") \
+            - rejects0
+        _chk(result, "batches_rejected_at_download", rejects > 0,
+             f"{rejects:.0f} batches rejected by download-time "
+             "validation")
+        _chk(result, "rejects_never_processed",
+             len(rejected_lists) > 0 and not leaked,
+             f"{len(rejected_lists)} rejected batches, "
+             f"{len(leaked)} reached process_chain_segment")
+        expired = counter_value("sync_request_deadline_expired_total") \
+            - expired0
+        _chk(result, "per_request_deadlines_fired", expired > 0,
+             f"{expired:.0f} per-request deadline expiries (stall peer)")
+        gstall = counter_value("sync_pump_global_stall_total") - gstall0
+        _chk(result, "zero_global_stalls", gstall == 0,
+             f"{gstall:.0f} global pump stalls (per-request deadlines "
+             "replace them)")
+        sp = watch.engine.status()["sync_progress"]
+        sp_incs = watch.engine.incidents_for("sync_progress")
+        _chk(result, "slo_sync_progress_clean",
+             sp["open_incident"] is None
+             and all(not i.open for i in sp_incs),
+             f"sync_progress SLO open_incident={sp['open_incident']}, "
+             f"{len(sp_incs)} incident(s) all resolved")
+        _envelope_checks(result, net, trace, max_head_lag=2)
+    finally:
+        for undo in restore:
+            undo()
+        net.stop()
+    return result
+
+
+# -- 7. backfill under stall --------------------------------------------------
+
+@scenario("backfill_under_stall")
+def scenario_backfill_under_stall(seed: int = 0) -> ScenarioResult:
+    """A checkpoint-synced node backfills its pre-anchor history while
+    one serving peer stalls every by-range request and another truncates
+    its responses.  The per-request deadline must fail the stalled
+    requests individually, consecutive failures must QUARANTINE the
+    stall peer, and backfill must still walk the anchor to genesis with
+    a complete block history."""
+    result = ScenarioResult("backfill_under_stall", seed)
+    spec = minimal_spec(altair_fork_epoch=0)
+    spe = spec.preset.slots_per_epoch
+    injector = FaultInjector(seed)
+    injector.set_behavior("n1", "n3",
+                          PeerBehavior("stall", stall_secs=5.0))
+    injector.set_behavior("n2", "n3",
+                          PeerBehavior("truncate", keep_fraction=0.5))
+    net = LocalNetwork(spec, 3, 48, topology="mesh", injector=injector)
+    quar0 = counter_value("sync_peer_quarantined_total")
+    expired0 = counter_value("sync_request_deadline_expired_total")
+    gstall0 = counter_value("sync_pump_global_stall_total")
+    try:
+        net.run_slots(4 * spe)               # finality for the anchor
+        fin0 = net.nodes[0].harness.chain.finalized_checkpoint()[0]
+        _chk(result, "anchor_finalized", fin0 >= 2,
+             f"anchor node finalized epoch {fin0}")
+        i3 = net.add_node(anchor_from=0, dial=[])
+        node3 = net.nodes[i3]
+        sync3 = node3.network.sync
+        chain3 = node3.harness.chain
+        # peer-table entries can be popped by benign duplicate-dial
+        # teardowns, so "never banned" is asserted on the on_ban
+        # callback, not on the entry's survival
+        bans: list[str] = []
+        real_on_ban = node3.network.peers.on_ban
+
+        def recording_on_ban(node_id):
+            bans.append(node_id)
+            real_on_ban(node_id)
+
+        node3.network.peers.on_ban = recording_on_ban
+        sync3.ctx.request_timeout = 0.75
+        bo = sync3.ctx.backoff
+        bo.BASE_DELAY = 0.05
+        bo.MAX_DELAY = 0.2
+        bo.QUARANTINE_AFTER = 2              # quarantine ON and quick
+        nid = [net.nodes[j].network.transport.node_id for j in range(3)]
+        for j in range(3):
+            node3.network.dial("127.0.0.1", net.nodes[j].network.port)
+        _wait_statuses(node3, nid)
+        anchor_start = chain3.store.backfill_anchor()
+        with scenario_capture() as trace:
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline:
+                sync3.backfill(batch_slots=4)
+                anchor = chain3.store.backfill_anchor()
+                if anchor is None or anchor[0] == 0:
+                    break
+                time.sleep(0.05)
+            net.run_slots(spe)               # envelope traffic
+        result.trace = trace
+        anchor = chain3.store.backfill_anchor()
+        _chk(result, "backfill_complete",
+             anchor is None or anchor[0] == 0,
+             f"backfill anchor {anchor} (started at {anchor_start})")
+        # every canonical block below the original anchor must now be in
+        # the synced node's store
+        checked = missing = 0
+        for blk in _chain_blocks(net.nodes[0].harness.chain):
+            if (anchor_start is not None
+                    and blk.message.slot < anchor_start[0]):
+                checked += 1
+                if chain3.store.get_block(htr(blk.message)) is None:
+                    missing += 1
+        _chk(result, "history_complete", checked > 0 and missing == 0,
+             f"{checked} pre-anchor canonical blocks checked, "
+             f"{missing} missing")
+        quarantined = counter_value("sync_peer_quarantined_total") - quar0
+        _chk(result, "stall_peer_quarantined", quarantined >= 1,
+             f"{quarantined:.0f} peer quarantines (stall peer cut off "
+             "after consecutive deadline failures)")
+        expired = counter_value("sync_request_deadline_expired_total") \
+            - expired0
+        _chk(result, "per_request_deadlines_fired", expired > 0,
+             f"{expired:.0f} per-request deadline expiries")
+        gstall = counter_value("sync_pump_global_stall_total") - gstall0
+        _chk(result, "zero_global_stalls", gstall == 0,
+             f"{gstall:.0f} global pump stalls")
+        served = injector.behaviors_served
+        _chk(result, "adversaries_served",
+             served.get("stall", 0) > 0 and served.get("truncate", 0) > 0,
+             f"byzantine serves: {dict(served)}")
+        info0 = node3.network.peers.peers.get(nid[0])
+        _chk(result, "honest_peer_retained",
+             nid[0] not in bans
+             and (info0 is None or not info0.banned),
+             "the honest serving peer was never banned")
+        _envelope_checks(result, net, trace, max_head_lag=2)
+    finally:
+        net.stop()
+    return result
+
+
+# -- 8. lying STATUS chain ----------------------------------------------------
+
+@scenario("lying_status_chain")
+def scenario_lying_status_chain(seed: int = 0) -> ScenarioResult:
+    """One peer answers STATUS with a fabricated far-ahead head and
+    finalized checkpoint.  Range sync forms a chain toward the fake
+    target, but every batch comes back empty: the consecutive-empty
+    fail-fast must abandon the chain after a bounded number of batches
+    (not walk 2000 fake slots), charge the liar `empty_batch`, and the
+    per-peer failed-target memory must keep the same lie from re-forming
+    the chain — all without disturbing the honest network."""
+    result = ScenarioResult("lying_status_chain", seed)
+    spec = minimal_spec(altair_fork_epoch=0)
+    spe = spec.preset.slots_per_epoch
+    injector = FaultInjector(seed)
+    lie = {"head_slot": 256 * spe, "head_root": "ab" * 32,
+           "finalized_epoch": 254, "finalized_root": "cd" * 32}
+    injector.set_behavior("n2", "n0",
+                          PeerBehavior("lying_status", status_lie=lie))
+    net = LocalNetwork(spec, 3, 32, topology="mesh", injector=injector)
+    watch = graftwatch.get()
+    dl0 = counter_value("sync_range_batches_downloaded_total")
+    eb0 = counter_value("sync_penalties_total_empty_batch")
+    try:
+        victim = net.nodes[0]
+        nid2 = net.nodes[2].network.transport.node_id
+        # the mesh dials both directions at once, so the victim can hold
+        # two connections to the liar; when the duplicate is torn down
+        # the PeerInfo entry goes with it.  That is a benign disconnect,
+        # not a ban — so the ban oracle is the on_ban callback itself,
+        # not the survival of the peer-table entry.
+        bans: list[str] = []
+        real_on_ban = victim.network.peers.on_ban
+
+        def recording_on_ban(node_id):
+            bans.append(node_id)
+            real_on_ban(node_id)
+
+        victim.network.peers.on_ban = recording_on_ban
+        with scenario_capture() as trace:
+            net.run_slots(spe)
+            # the liar's own outbound STATUS (served honestly BY the
+            # victim's transport) races the lie on the victim's peer
+            # table; force one synchronous exchange so the fake-ahead
+            # STATUS deterministically had the last word at least once.
+            # The mesh dial itself can still be mid-handshake right
+            # after warmup, so wait for the connection (re-dialing if
+            # it never lands) before forcing the exchange.
+            def _liar_conn():
+                return next((p for p in
+                             victim.network.transport.peers.values()
+                             if p.node_id == nid2), None)
+            deadline = time.monotonic() + 10.0
+            peer2 = _liar_conn()
+            while peer2 is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+                peer2 = _liar_conn()
+            if peer2 is None:
+                victim.network.dial("127.0.0.1",
+                                    net.nodes[2].network.port)
+                while peer2 is None and time.monotonic() < deadline + 5.0:
+                    time.sleep(0.05)
+                    peer2 = _liar_conn()
+            victim.network._status_exchange(peer2)
+            net.run_slots(2 * spe)
+        result.trace = trace
+        served = injector.behaviors_served.get("lying_status", 0)
+        _chk(result, "lie_served", served > 0,
+             f"{served} fabricated STATUS responses served")
+        empty_pen = counter_value("sync_penalties_total_empty_batch") \
+            - eb0
+        _chk(result, "liar_charged_empty_batch", empty_pen > 0,
+             f"{empty_pen:.0f} empty_batch penalties for the fake "
+             "target's pool")
+        downloaded = counter_value("sync_range_batches_downloaded_total") \
+            - dl0
+        _chk(result, "fail_fast_bounded", 0 < downloaded < 40,
+             f"{downloaded:.0f} batches downloaded before the "
+             "consecutive-empty fail-fast (naive walk to the fake head "
+             f"would be ~{(256 * spe) // (2 * spe)})")
+        # the liar keeps gossiping honestly (it is a real validator
+        # node), so its NET score stays positive — the precise outcome
+        # is attribution: both fabricated targets are remembered as
+        # failed *from this peer* and cannot re-form a chain
+        fake_roots = {bytes.fromhex("ab" * 32), bytes.fromhex("cd" * 32)}
+        blocked = {k for k, pool in
+                   victim.network.sync.range.failed_from.items()
+                   if k[1] in fake_roots and nid2 in pool}
+        _chk(result, "fake_targets_blocked_for_liar", len(blocked) > 0,
+             f"{len(blocked)} fabricated target(s) in the per-peer "
+             "failed-target memory, pinned on the liar")
+        info2 = victim.network.peers.peers.get(nid2)
+        state = ("still connected (score "
+                 f"{victim.network.peers.score(nid2):.1f})"
+                 if info2 is not None else
+                 "duplicate connection torn down, never banned")
+        _chk(result, "liar_not_banned",
+             nid2 not in bans and (info2 is None or not info2.banned),
+             f"liar {state}: a STATUS lie alone is penalized, "
+             "not ban-worthy")
+        heads = {n.harness.chain.head().head_block_root
+                 for n in net.live_nodes}
+        _chk(result, "converged", len(heads) == 1,
+             f"{len(heads)} distinct heads — honest traffic undisturbed")
+        sp = watch.engine.status()["sync_progress"]
+        _chk(result, "slo_sync_progress_clean",
+             sp["open_incident"] is None,
+             f"sync_progress SLO clean ({sp['last_detail']})")
+        _envelope_checks(result, net, trace)
     finally:
         net.stop()
     return result

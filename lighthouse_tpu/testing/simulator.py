@@ -228,6 +228,22 @@ class LocalNetwork:
             node.network.dial("127.0.0.1", self.nodes[j].network.port)
         return i
 
+    def add_fresh_node(self, dial: list[int] | None = None) -> int:
+        """Join a GENESIS-state node mid-run: it shares the network's
+        deterministic interop genesis but has imported nothing, so it
+        must RANGE-SYNC the whole history from its peers — the
+        byzantine-sync victim (ISSUE 11).  Runs no validators.  `dial=[]`
+        suppresses dialing so a scenario can tune the node's sync knobs
+        before any STATUS exchange triggers `maybe_sync`."""
+        h = BeaconChainHarness(self.spec, self.validator_count)
+        h.set_slot(int(self.live_nodes[0].harness.chain.slot()))
+        i = len(self.nodes)
+        node = self._wire_node(h, f"n{i}")
+        self.nodes.append(node)
+        for j in (dial if dial is not None else self._dial_targets(i)):
+            node.network.dial("127.0.0.1", self.nodes[j].network.port)
+        return i
+
     # -- fault control -------------------------------------------------------
 
     def kill_node(self, i: int) -> None:

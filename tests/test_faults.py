@@ -7,8 +7,10 @@ fabric guarantees works without the cryptography package.
 """
 import time
 
+import pytest
+
 from lighthouse_tpu.network.faults import (
-    FaultInjector, FaultyTransport, LinkPolicy, ScenarioClock,
+    FaultInjector, FaultyTransport, LinkPolicy, PeerBehavior, ScenarioClock,
 )
 
 
@@ -100,6 +102,45 @@ def test_heal_flushes_held_frames_in_submit_order():
     # policies cleared: the link is transparent again
     inj.on_gossip_frame("a", "b", got.append, b"post")
     assert got[-1] == b"post"
+
+
+def test_peer_behavior_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        PeerBehavior("slowpoke")
+    for kind in ("stall", "junk", "truncate", "trickle", "lying_status"):
+        PeerBehavior(kind)                # every documented kind constructs
+
+
+def test_lying_status_defaults_to_the_status_protocol():
+    liar = PeerBehavior("lying_status", status_lie={"head_slot": 999})
+    assert liar.protocols == ("status",)
+    # an explicit protocol tuple is honored, not overwritten
+    both = PeerBehavior("lying_status",
+                        protocols=("status", "beacon_blocks_by_range"))
+    assert both.protocols == ("status", "beacon_blocks_by_range")
+    # non-status kinds keep the by_range default
+    assert PeerBehavior("stall").protocols == ("beacon_blocks_by_range",)
+
+
+def test_set_behavior_is_directed_and_clearable():
+    inj = FaultInjector(0)
+    b = PeerBehavior("junk")
+    inj.set_behavior("a", "b", b)
+    assert inj.behavior("a", "b") is b
+    assert inj.behavior("b", "a") is None     # directed, not symmetric
+    assert inj.behavior("a", None) is None    # unlabeled peers untouched
+    assert inj.behavior(None, "b") is None
+    inj.set_behavior("a", "b", None)
+    assert inj.behavior("a", "b") is None
+
+
+def test_heal_clears_behaviors_and_counts_survive():
+    inj = FaultInjector(0)
+    inj.set_behavior("a", "b", PeerBehavior("stall"))
+    inj.note_behavior("stall")
+    inj.heal()
+    assert inj.behavior("a", "b") is None
+    assert inj.behaviors_served == {"stall": 1}   # the ledger is history
 
 
 def test_scenario_clock_is_explicit():

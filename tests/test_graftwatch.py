@@ -132,6 +132,65 @@ def test_every_default_slo_watches_a_catalog_metric():
             "which is not in api/metrics_defs.CATALOG")
 
 
+def _sync_progress_engine():
+    s = timeseries.SlotSampler(window=32)
+    eng = slo.SLOEngine(s, slos=[
+        o for o in slo.default_slos(sync_floor_blocks=1.0,
+                                    sync_stall_slots=3)
+        if o.name == "sync_progress"])
+    return s, eng
+
+
+def test_sync_progress_slo_clean_when_not_syncing():
+    s, eng = _sync_progress_engine()
+    for slot in range(1, 6):
+        s.record("gauge", "sync_state", 0)     # synced the whole time
+        s.sample(slot)
+        eng.evaluate(slot)
+    assert eng.open_incidents() == []
+    assert eng.status()["sync_progress"]["last_detail"] == "not syncing"
+
+
+def test_sync_progress_slo_opens_after_consecutive_stalled_slots():
+    s, eng = _sync_progress_engine()
+    for slot in (1, 2):                        # syncing and importing
+        s.record("gauge", "sync_state", 1)
+        s.record("counter", "sync_range_blocks_imported_total", 8)
+        s.sample(slot)
+        eng.evaluate(slot)
+    assert eng.open_incidents() == []
+    for slot in (3, 4):                        # two stalled slots: grace
+        s.record("gauge", "sync_state", 1)
+        s.sample(slot)
+        eng.evaluate(slot)
+    assert eng.open_incidents() == []
+    s.record("gauge", "sync_state", 1)         # third consecutive: breach
+    s.sample(5)
+    opened = eng.evaluate(5)
+    assert [i.slo for i in opened] == ["sync_progress"]
+
+
+def test_sync_progress_slo_stall_run_resets_on_progress_or_sync_end():
+    s, eng = _sync_progress_engine()
+    for slot in (1, 2):                        # 2 stalled syncing slots
+        s.record("gauge", "sync_state", 1)
+        s.sample(slot)
+        eng.evaluate(slot)
+    s.record("gauge", "sync_state", 1)         # progress: run resets
+    s.record("counter", "sync_range_blocks_imported_total", 3)
+    s.sample(3)
+    eng.evaluate(3)
+    for slot in (4, 5):                        # only 2 stalled again
+        s.record("gauge", "sync_state", 1)
+        s.sample(slot)
+        eng.evaluate(slot)
+    assert eng.open_incidents() == []
+    s.record("gauge", "sync_state", 0)         # sync finished: clean
+    s.sample(6)
+    eng.evaluate(6)
+    assert eng.open_incidents() == []
+
+
 def test_graftwatch_backwards_slot_resets_engine_and_sampler():
     w = graftwatch.get()
     w.reset()

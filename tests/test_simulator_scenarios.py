@@ -28,16 +28,18 @@ def test_slow_scenario_passes(name):
     assert result.ok, "\n" + result.render()
 
 
-def test_scenario_is_deterministic_for_a_fixed_seed():
+@pytest.mark.parametrize("name", ["equivocation", "lying_status_chain"])
+def test_scenario_is_deterministic_for_a_fixed_seed(name):
     """Same seed, same verdicts: the acceptance bar for the whole suite
-    is reproducibility, so the cheapest scenario runs twice and every
-    check must land identically (details carry wall-clock timings, so
-    only the (name, ok) sequence is compared)."""
-    a = run_scenario("equivocation", seed=0)
-    b = run_scenario("equivocation", seed=0)
+    is reproducibility, so the cheapest scenario of each family — one
+    fault-fabric, one byzantine-sync — runs twice and every check must
+    land identically (details carry wall-clock timings, so only the
+    (name, ok) sequence is compared)."""
+    a = run_scenario(name, seed=0)
+    b = run_scenario(name, seed=0)
     assert [(c.name, c.ok) for c in a.checks] == \
            [(c.name, c.ok) for c in b.checks]
-    assert a.ok and b.ok
+    assert a.ok and b.ok, "\n" + a.render() + "\n" + b.render()
 
 
 def test_unknown_scenario_is_a_keyerror():

@@ -8,13 +8,18 @@ attribution, chain selection, and depth limits.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 import pytest
 
+from lighthouse_tpu.chain.errors import PARENT_UNKNOWN
 from lighthouse_tpu.network.sync.backfill import BackfillSync
 from lighthouse_tpu.network.sync.batches import Batch, BatchState
 from lighthouse_tpu.network.sync.lookups import BlockLookups, Lookup
+from lighthouse_tpu.network.sync.manager import (
+    PeerBackoff, _RealSyncContext,
+)
 from lighthouse_tpu.network.sync.range_sync import RangeSync, SyncingChain
 
 
@@ -167,6 +172,50 @@ def test_batch_prefers_fresh_peer_on_retry():
     assert b.pick_peer(["p1", "p2"]) == "p2"
     # pool exhausted -> falls back to an attempted peer
     assert b.pick_peer(["p1"]) == "p1"
+
+
+def test_batch_pick_peer_salt_rotates_the_choice():
+    """A deterministic pool[0] pick would hand every retry to the same
+    failed peer; the salt must rotate through both fresh peers and (once
+    exhausted) the whole pool."""
+    b = Batch(3, 8, 16)
+    pool = ["p0", "p1", "p2"]
+    assert {b.pick_peer(pool, salt=s) for s in range(3)} == set(pool)
+    for p in pool:
+        b.attempted_peers.add(p)
+    assert {b.pick_peer(pool, salt=s) for s in range(3)} == set(pool)
+    assert b.pick_peer([], salt=7) is None
+
+
+def test_batch_processing_exhaustion_fails_at_exact_cap():
+    b = Batch(0, 8, 16)
+    for i in range(Batch.MAX_PROCESSING_ATTEMPTS):
+        b.start_download(f"p{i}", i)
+        b.downloaded(["blk"])
+        b.start_processing()
+        expect = (BatchState.FAILED
+                  if i == Batch.MAX_PROCESSING_ATTEMPTS - 1
+                  else BatchState.AWAITING_DOWNLOAD)
+        assert b.processing_failed() == expect
+    assert b.state == BatchState.FAILED
+
+
+def test_batch_illegal_transitions_assert():
+    b = Batch(0, 8, 16)
+    with pytest.raises(AssertionError):
+        b.downloaded(["blk"])                  # not downloading yet
+    with pytest.raises(AssertionError):
+        b.start_processing()                   # nothing downloaded
+    b.start_download("p1", 0)
+    with pytest.raises(AssertionError):
+        b.start_download("p2", 1)              # already in flight
+    b.downloaded(["blk"])
+    with pytest.raises(AssertionError):
+        b.download_failed()                    # download already done
+    b.start_processing()
+    b.processed()
+    with pytest.raises(AssertionError):
+        b.processing_failed()                  # already processed
 
 
 # ---------------------------------------------------------------------------
@@ -327,6 +376,286 @@ def test_stale_response_after_chain_drop_is_ignored():
     chain.requests.pop(rid)                    # simulate dropped request
     rs.on_range_response(rid, mk_chain_blocks(1, 4))
     assert ctx.processed == []
+
+
+def test_download_failure_reason_selects_penalty():
+    """The pump's failure classification rides through on_range_response
+    and picks the penalty weight (ISSUE 11 reason-aware attribution)."""
+    for reason in ("stall", "peer_gone", "decode_error", "timeout"):
+        ctx = FakeCtx(spe=8)
+        rs, chain = mk_synced_chain(ctx, n_peers=1, target_slot=15)
+        rid, peer, _, _ = ctx.sent[0]
+        rs.on_range_response(rid, None, reason=reason)
+        assert (peer, reason) in ctx.penalties
+    # "shutdown" is our own close path: the batch still fails over, but
+    # real contexts drop the penalty (FakeCtx records it verbatim)
+
+
+# ---------------------------------------------------------------------------
+# Range sync: download-time validation + per-peer failed-target memory
+# ---------------------------------------------------------------------------
+
+def test_out_of_range_batch_rejected_before_processing():
+    ctx = FakeCtx(spe=8)
+    rs, chain = mk_synced_chain(ctx, n_peers=2, target_slot=31)
+    rid0, peer0, _, _ = ctx.sent[0]
+    junk = mk_chain_blocks(100, 4)             # real-looking, wrong range
+    rs.on_range_response(rid0, junk)
+    assert (peer0, "bad_segment") in ctx.penalties
+    assert ctx.processed == []                 # never reached the chain
+    assert chain.batches[0].state == BatchState.AWAITING_DOWNLOAD
+
+
+def test_truncated_tail_blamed_on_previous_batch():
+    """Batch k passes validation but breaks continuity against the
+    PROCESSED batch k-1: blame (and roll back) k-1's truncated tail,
+    accept k's response, and complete after an honest re-serve."""
+    ctx = FakeCtx(spe=8)
+    rs, chain = mk_synced_chain(ctx, n_peers=2, target_slot=31)
+    blocks = mk_chain_blocks(1, 32)            # slots 1..32, hash-linked
+    (rid0, peer0, _, _), (rid1, peer1, _, _) = ctx.sent[:2]
+    rs.on_range_response(rid0, blocks[:12])    # [1,17) minus its tail
+    assert chain.batches[0].state == BatchState.PROCESSED
+    rs.on_range_response(rid1, blocks[16:32])  # [17,33), can't link
+    assert (peer0, "truncated_batch") in ctx.penalties
+    assert (peer1, "bad_segment") not in ctx.penalties
+    assert chain.process_ptr == 0              # k-1 rolled back
+    assert chain.batches[1].state == BatchState.AWAITING_PROCESSING
+    redo = chain.batches[0]
+    assert redo.state == BatchState.DOWNLOADING and redo.peer == peer1
+    rs.on_range_response(redo.req_id, blocks[:16])
+    assert chain.complete
+
+
+def test_parent_unknown_rolls_back_previous_batch_with_blame():
+    ctx = FakeCtx(spe=8)
+    rs, chain = mk_synced_chain(ctx, n_peers=2, target_slot=31)
+    blocks = mk_chain_blocks(1, 32)
+    (rid0, peer0, _, _), (rid1, peer1, _, _) = ctx.sent[:2]
+    rs.on_range_response(rid0, blocks[:16])
+    # batch 1 passes download validation but the chain reports its
+    # parent unknown at processing (k-1's tail lied deeper than roots)
+    ctx.process_results.append((0, PARENT_UNKNOWN))
+    rs.on_range_response(rid1, blocks[16:32])
+    assert (peer0, "truncated_batch") in ctx.penalties
+    assert chain.process_ptr == 0
+    assert chain.batches[1].state == BatchState.AWAITING_PROCESSING
+    assert chain.batches[0].state == BatchState.DOWNLOADING
+
+
+def test_parent_unknown_exhaustion_fails_chain():
+    ctx = FakeCtx(spe=8)
+    rs, chain = mk_synced_chain(ctx, n_peers=2, target_slot=31)
+    blocks = mk_chain_blocks(1, 32)
+    (rid0, _, _, _), (rid1, _, _, _) = ctx.sent[:2]
+    rs.on_range_response(rid0, blocks[:16])
+    chain.batches[0].processing_attempts = Batch.MAX_PROCESSING_ATTEMPTS
+    ctx.process_results.append((0, PARENT_UNKNOWN))
+    rs.on_range_response(rid1, blocks[16:32])
+    assert chain.failed
+
+
+def test_failed_target_blocked_only_for_failed_pool():
+    """ISSUE 11: a byzantine pool that fails a chain must not poison its
+    target for honest peers that show up later."""
+    ctx = FakeCtx(spe=8)
+    rs = RangeSync(ctx)
+    st = status_ahead(fin_epoch=2, head_slot=40)
+    rs.add_peer("bad1", st)
+    rs.add_peer("bad2", st)
+    chain = rs.drive()
+    fin_key = ("finalized", st.finalized_root, 16)
+    assert fin_key in rs.chains
+    chain.failed = True
+    assert rs.best_chain() is None             # purged
+    assert rs.failed_from[fin_key] == {"bad1", "bad2"}
+    rs.add_peer("bad1", st)                    # falls through to head
+    assert fin_key not in rs.chains
+    rs.add_peer("fresh", st)                   # honest newcomer: re-forms
+    assert fin_key in rs.chains
+    assert rs.chains[fin_key].peers == {"fresh"}
+
+
+def test_completed_target_retired_for_everyone():
+    ctx = FakeCtx(spe=8)
+    rs = RangeSync(ctx)
+    st = status_ahead(fin_epoch=2, head_slot=40)
+    rs.add_peer("p1", st)
+    chain = rs.drive()
+    fin_key = ("finalized", st.finalized_root, 16)
+    chain.complete = True
+    rs.best_chain()
+    assert fin_key in rs.retired
+    rs.add_peer("newcomer", st)                # stale STATUS for a done
+    assert fin_key not in rs.chains            # target can't resurrect it
+
+
+def test_stale_failed_chain_does_not_blame_newcomers():
+    """add_peer may find a failed chain the lazy purge hasn't swept yet;
+    the arriving peer must not be folded into that pool's blame set."""
+    ctx = FakeCtx(spe=8)
+    rs = RangeSync(ctx)
+    st = status_ahead(fin_epoch=2, head_slot=40)
+    rs.add_peer("bad1", st)
+    chain = rs.drive()
+    chain.failed = True                        # no best_chain() purge yet
+    fin_key = ("finalized", st.finalized_root, 16)
+    rs.add_peer("fresh", st)
+    assert "fresh" not in rs.failed_from.get(fin_key, set())
+    assert rs.chains[fin_key].peers == {"fresh"}
+
+
+# ---------------------------------------------------------------------------
+# PeerBackoff
+# ---------------------------------------------------------------------------
+
+def test_backoff_grows_exponentially_with_jitter_bounds():
+    bo = PeerBackoff(seed=7)
+    expected = [0.5, 1.0, 2.0, 4.0, 8.0, 8.0]  # BASE * 2^n capped at MAX
+    for base in expected:
+        d = bo.note_failure("p1")
+        assert 0.5 * base <= d <= 1.5 * base
+    assert bo.delay_remaining("p1") > 0.0
+    assert bo.delay_remaining("other") == 0.0
+
+
+def test_backoff_quarantines_at_exact_threshold():
+    bo = PeerBackoff(seed=1)
+    for _ in range(PeerBackoff.QUARANTINE_AFTER - 1):
+        bo.note_failure("p1")
+        assert not bo.quarantined("p1")
+    bo.note_failure("p1")
+    assert bo.quarantined("p1")
+    assert not bo.quarantined("p2")
+
+
+def test_backoff_success_clears_the_slate():
+    bo = PeerBackoff(seed=1)
+    for _ in range(PeerBackoff.QUARANTINE_AFTER):
+        bo.note_failure("p1")
+    assert bo.quarantined("p1")
+    bo.note_success("p1")
+    assert not bo.quarantined("p1")
+    assert bo.delay_remaining("p1") == 0.0
+    d = bo.note_failure("p1")                  # counter restarted
+    assert d <= 1.5 * PeerBackoff.BASE_DELAY
+
+
+def test_backoff_quarantine_expires():
+    bo = PeerBackoff(seed=1)
+    bo.QUARANTINE_SECS = 0.05                  # instance shadow
+    for _ in range(PeerBackoff.QUARANTINE_AFTER):
+        bo.note_failure("p1")
+    assert bo.quarantined("p1")
+    time.sleep(0.06)
+    assert not bo.quarantined("p1")
+
+
+# ---------------------------------------------------------------------------
+# _RealSyncContext deadline pump (stub rpc, no network)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _StubPeer:
+    node_id: str
+
+
+class _StubTransport:
+    def __init__(self, peers):
+        self.peers = {p: _StubPeer(p) for p in peers}
+
+
+class _StubRpc:
+    """Per-peer canned behavior: 'hang' sleeps past any deadline, 'empty'
+    answers immediately, 'garbage' returns an undecodable payload."""
+
+    def __init__(self, behaviors):
+        self.behaviors = behaviors
+        self.transport = _StubTransport(list(behaviors))
+
+    def request(self, peer, protocol, payload, timeout=None):
+        kind = self.behaviors[peer.node_id]
+        if kind == "hang":
+            time.sleep(1.0)
+            return []
+        if kind == "garbage":
+            return ["zz-not-hex"]
+        return []
+
+
+class _StubPeerManager:
+    def __init__(self):
+        self.reports = []
+
+    def report(self, node_id, event):
+        self.reports.append((node_id, event))
+
+
+class _RecordingOwner:
+    def __init__(self):
+        self.responses = []
+
+    def on_range_response(self, rid, blocks, reason="timeout"):
+        self.responses.append((rid, blocks, reason))
+
+
+def _mk_ctx(behaviors, timeout=0.15):
+    ctx = _RealSyncContext(chain=None, rpc=_StubRpc(behaviors),
+                           peer_manager=_StubPeerManager())
+    ctx.request_timeout = timeout
+    ctx.backoff.BASE_DELAY = 0.0               # keep deadlines tight
+    ctx.backoff.MAX_DELAY = 0.0
+    return ctx
+
+
+def test_pump_expires_stalled_request_individually():
+    ctx = _mk_ctx({"slow": "hang", "fast": "empty"})
+    owner = _RecordingOwner()
+    try:
+        rid_slow = ctx.send_range("slow", 1, 4, owner)
+        rid_fast = ctx.send_range("fast", 5, 4, owner)
+        t0 = time.monotonic()
+        ctx.pump()
+        elapsed = time.monotonic() - t0
+        got = dict((rid, (blocks, reason))
+                   for rid, blocks, reason in owner.responses)
+        # the stalled request expired alone, with the "stall" reason...
+        assert got[rid_slow] == (None, "stall")
+        # ...while the honest peer's response was delivered intact
+        assert got[rid_fast][0] == []
+        assert elapsed < 0.8                   # did NOT ride out the hang
+        assert ctx.inflight == {}
+        # only the stalling peer was charged a backoff failure
+        assert ctx.backoff._fails.get("slow", 0) == 1
+        assert ctx.backoff._fails.get("fast", 0) == 0
+    finally:
+        ctx.close()
+
+
+def test_pump_classifies_peer_gone_and_decode_error():
+    ctx = _mk_ctx({"garbler": "garbage"})
+    owner = _RecordingOwner()
+    try:
+        rid_gone = ctx.send_range("vanished", 1, 4, owner)
+        rid_bad = ctx.send_range("garbler", 1, 4, owner)
+        ctx.pump()
+        got = dict((rid, (blocks, reason))
+                   for rid, blocks, reason in owner.responses)
+        assert got[rid_gone] == (None, "peer_gone")
+        assert got[rid_bad] == (None, "decode_error")
+    finally:
+        ctx.close()
+
+
+def test_closed_context_fails_requests_as_shutdown():
+    ctx = _mk_ctx({"fast": "empty"})
+    owner = _RecordingOwner()
+    ctx.close()
+    rid = ctx.send_range("fast", 1, 4, owner)
+    ctx.pump()
+    assert owner.responses == [(rid, None, "shutdown")]
+    # our own close path never charges the peer
+    assert ctx.backoff._fails.get("fast", 0) == 0
 
 
 # ---------------------------------------------------------------------------
