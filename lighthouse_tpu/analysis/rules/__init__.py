@@ -6,6 +6,7 @@ from . import (  # noqa: F401
     lock_discipline,
     lock_order,
     recompilation,
+    serving_cache_discipline,
     shutdown_order,
     spec_constants,
     ssz_schema,

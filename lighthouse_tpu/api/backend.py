@@ -17,6 +17,7 @@ from ..state_transition.helpers import (
     committee_cache, compute_epoch_at_slot, compute_start_slot_at_epoch,
     get_beacon_proposer_index,
 )
+from .serving.coalesce import Coalescer
 
 
 class ApiError(Exception):
@@ -32,6 +33,10 @@ class ApiBackend:
         #: blinded block returns (execution_layer/src/lib.rs get_payload
         #: + unblinding flow); block_hash -> ExecutionPayload
         self._blinded_payloads: dict[bytes, object] = {}
+        #: single-flight gate for attester-cache priming: N concurrent
+        #: attestation_data misses for the same (epoch, head) replay
+        #: once, not N times (ISSUE 12 thundering-herd fix)
+        self._attester_primer = Coalescer()
 
     # -- node ----------------------------------------------------------------
 
@@ -383,14 +388,25 @@ class ApiBackend:
             raise ApiError(400, str(e))
         head = chain.head()
         st = head.head_state
-        if st.slot < slot:
-            st = st.copy()
-            process_slots(st, slot)
-            # prime the attester cache: this (epoch, chain) replays once
-            chain.attester_cache.cache_state(chain, st)
         T = chain.T
         spe = chain.spec.preset.slots_per_epoch
         epoch = compute_epoch_at_slot(slot, spe)
+        if st.slot < slot:
+            # prime the attester cache once per (epoch, head): the
+            # single-flight gate makes concurrent misses share ONE
+            # replay instead of each paying process_slots + cache_state
+            def _prime():
+                pst = head.head_state.copy()
+                process_slots(pst, slot)
+                chain.attester_cache.cache_state(chain, pst)
+                return pst
+            st, _led = self._attester_primer.do(
+                ("attester_prime", epoch, head.head_block_root), _prime)
+            if st.slot < slot:
+                # a concurrent leader primed to an earlier slot of this
+                # epoch; finish the (short) replay privately
+                st = st.copy()
+                process_slots(st, slot)
         head_epoch = st.current_epoch()
         # the source an epoch-E attestation needs is the checkpoint that
         # was *current during E*; from a later head state that is only

@@ -17,6 +17,7 @@ from .. import obs
 from ..ssz import deserialize, serialize
 from ..utils.log_buffer import global_log_buffer, to_sse
 from .backend import ApiBackend, ApiError
+from .serving import CachedResponse, ServingTier, ShedError
 
 
 class Resp:
@@ -36,13 +37,6 @@ class Resp:
         self.payload_fn = payload_fn   # () -> (json_payload, version)
         self.version = version         # str or callable () -> str
         self.ssz = ssz                 # callable () -> bytes, or bytes
-
-
-def _att_data_json(backend: ApiBackend, q) -> dict:
-    data = backend.attestation_data(int(q["slot"][0]),
-                                    int(q["committee_index"][0]))
-    t = type(data).ssz_type
-    return {"ssz": serialize(t, data).hex()}
 
 
 def _aggregate_ssz(backend: ApiBackend, q):
@@ -66,12 +60,47 @@ def _one_validator(backend: ApiBackend, state_id: str, vid: str) -> dict:
     return out[0]
 
 
+class _CappedThreadingHTTPServer(ThreadingHTTPServer):
+    """Thread-per-connection with a hard connection cap: the fleet's
+    keep-alive connections are long-lived, so an uncapped acceptor is an
+    unbounded thread pool.  Over the cap we answer a raw 503 and close
+    instead of accepting work we cannot finish."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, handler, max_connections: int = 256):
+        self._conn_slots = threading.Semaphore(max_connections)
+        super().__init__(addr, handler)
+
+    def process_request(self, request, client_address):
+        if not self._conn_slots.acquire(blocking=False):
+            try:
+                request.sendall(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Content-Length: 0\r\nConnection: close\r\n\r\n")
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            return
+        super().process_request(request, client_address)
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._conn_slots.release()
+
+
 class BeaconApiServer:
     def __init__(self, backend: ApiBackend, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, max_connections: int = 256,
+                 idle_timeout: float = 30.0):
         self.backend = backend
-        handler = _make_handler(backend)
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.serving = ServingTier(backend)
+        handler = _make_handler(backend, serving=self.serving,
+                                idle_timeout=idle_timeout)
+        self.httpd = _CappedThreadingHTTPServer(
+            (host, port), handler, max_connections=max_connections)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
 
@@ -130,7 +159,13 @@ def _versioned(envelope_fn, ssz_fn=None, version_fn=None) -> Resp:
     return Resp(payload_fn=envelope_fn, version=version_fn, ssz=ssz_fn)
 
 
-def build_get_routes(backend: ApiBackend):
+def build_get_routes(backend: ApiBackend, serving: ServingTier | None = None):
+    # the serving tier fronts every coalesced endpoint below — routes
+    # for attestation_data / duties / headers / light-client objects
+    # must go through it, never straight to the backend (pinned by the
+    # serving-cache-discipline lint rule)
+    if serving is None:
+        serving = ServingTier(backend)
     return [
         (re.compile(r"^/eth/v1/beacon/genesis$"),
          lambda m, q: {"data": backend.genesis()}),
@@ -151,15 +186,14 @@ def build_get_routes(backend: ApiBackend):
         (re.compile(r"^/eth/v1/node/syncing$"),
          lambda m, q: {"data": backend.syncing()}),
         (re.compile(r"^/eth/v1/validator/duties/proposer/(\d+)$"),
-         lambda m, q: {"data": [
-             {"slot": str(s), "validator_index": str(v), "pubkey": "0x00"}
-             for s, v in backend.get_proposer_duties(int(m[1]))]}),
+         lambda m, q: serving.proposer_duties(int(m[1]))),
         (re.compile(r"^/lighthouse/health$"),
          lambda m, q: {"data": {"healthy": backend.is_healthy()}}),
         (re.compile(r"^/lighthouse/syncing$"),
          lambda m, q: {"data": backend.syncing()}),
         (re.compile(r"^/eth/v1/validator/attestation_data$"),
-         lambda m, q: {"data": _att_data_json(backend, q)}),
+         lambda m, q: serving.attestation_data(
+             int(q["slot"][0]), int(q["committee_index"][0]))),
         (re.compile(r"^/eth/v1/validator/validator_index$"),
          lambda m, q: {"data": {"index": backend.get_validator_index(
              bytes.fromhex(q["pubkey"][0][2:]))}}),
@@ -210,7 +244,7 @@ def build_get_routes(backend: ApiBackend):
              lambda: backend.chain.spec.fork_name_at_slot(
                  int(m[1])).name.lower())),
         (re.compile(r"^/eth/v1/beacon/light_client/bootstrap/([^/]+)$"),
-         lambda m, q: {"data": backend.light_client_bootstrap(m[1])}),
+         lambda m, q: serving.light_client_bootstrap(m[1])),
         (re.compile(r"^/eth/v1/beacon/pool/bls_to_execution_changes$"),
          lambda m, q: {"data": backend.pool_ops(
              "bls_to_execution_changes")}),
@@ -237,10 +271,10 @@ def build_get_routes(backend: ApiBackend):
         (re.compile(r"^/eth/v1/beacon/blob_sidecars/([^/]+)$"),
          lambda m, q: {"data": backend.blob_sidecars(m[1])}),
         (re.compile(r"^/eth/v1/beacon/headers$"),
-         lambda m, q: {"data": backend.headers(
+         lambda m, q: serving.headers(
              int(q["slot"][0]) if "slot" in q else None,
              bytes.fromhex(q["parent_root"][0][2:])
-             if "parent_root" in q else None)}),
+             if "parent_root" in q else None)),
         # -- beacon: state views --
         (re.compile(r"^/eth/v1/beacon/states/([^/]+)/validators/([^/]+)$"),
          lambda m, q: {"data": _one_validator(backend, m[1], m[2])}),
@@ -276,15 +310,15 @@ def build_get_routes(backend: ApiBackend):
         # -- light client --
         (re.compile(
             r"^/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)$"),
-         lambda m, q: {"data": backend.light_client_bootstrap(m[1])}),
+         lambda m, q: serving.light_client_bootstrap(m[1])),
         (re.compile(r"^/eth/v1/beacon/light_client/finality_update$"),
-         lambda m, q: {"data": backend.light_client_finality_update()}),
+         lambda m, q: serving.light_client_finality_update()),
         (re.compile(r"^/eth/v1/beacon/light_client/optimistic_update$"),
-         lambda m, q: {"data": backend.light_client_optimistic_update()}),
+         lambda m, q: serving.light_client_optimistic_update()),
         (re.compile(r"^/eth/v1/beacon/light_client/updates$"),
-         lambda m, q: {"data": backend.light_client_updates(
+         lambda m, q: serving.light_client_updates(
              int(q.get("start_period", [0])[0]),
-             int(q.get("count", [1])[0]))}),
+             int(q.get("count", [1])[0]))),
         # -- config --
         (re.compile(r"^/eth/v1/config/spec$"),
          lambda m, q: {"data": backend.config_spec()}),
@@ -486,11 +520,18 @@ def _graftwatch_series(q) -> dict:
             "values": [None if v != v else float(v) for v in values]}
 
 
-def _make_handler(backend: ApiBackend):
-    routes_get = build_get_routes(backend)
+def _make_handler(backend: ApiBackend, serving: ServingTier | None = None,
+                  idle_timeout: float = 30.0):
+    if serving is None:
+        serving = ServingTier(backend)
+    routes_get = build_get_routes(backend, serving)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # keep-alive idle timeout: a silent connection trips the socket
+        # timeout in handle_one_request, which closes it — the fleet
+        # reuses connections but cannot park them forever
+        timeout = idle_timeout
 
         def log_message(self, *args):  # quiet
             pass
@@ -505,6 +546,17 @@ def _make_handler(backend: ApiBackend):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _entry(self, entry: CachedResponse) -> None:
+            """Write a serving-tier response: pre-encoded bytes, no
+            re-serialization on this path."""
+            self.send_response(200)
+            self.send_header("Content-Type", entry.content_type)
+            if entry.version is not None:
+                self.send_header("Eth-Consensus-Version", entry.version)
+            self.send_header("Content-Length", str(len(entry.body)))
+            self.end_headers()
+            self.wfile.write(entry.body)
 
         def _raw(self, raw: bytes, version: str | None = None) -> None:
             self.send_response(200)
@@ -575,11 +627,15 @@ def _make_handler(backend: ApiBackend):
                 if m:
                     try:
                         out = fn(m, q)
+                        if isinstance(out, CachedResponse):
+                            return self._entry(out)
                         if isinstance(out, Resp):
                             return self._negotiate(out)
                         return self._json(200, out)
                     except ApiError as e:
                         return self._json(e.status, {"message": str(e)})
+                    except ShedError as e:
+                        return self._json(503, {"message": str(e)})
                     except Exception as e:
                         return self._json(500, {"message": repr(e)})
             self._json(404, {"message": "route not found"})
@@ -622,13 +678,8 @@ def _make_handler(backend: ApiBackend):
                              url.path)
                 if m:
                     indices = [int(i) for i in json.loads(body)]
-                    duties = backend.get_attester_duties(int(m[1]), indices)
-                    return self._json(200, {"data": [
-                        {"slot": str(s), "committee_index": str(ci),
-                         "validator_index": str(vi),
-                         "committee_length": str(cl),
-                         "validator_committee_index": str(pos)}
-                        for s, ci, vi, cl, pos in duties]})
+                    return self._entry(
+                        serving.attester_duties(int(m[1]), indices))
                 if url.path == "/eth/v1/beacon/pool/attestations":
                     from ..specs.chain_spec import ForkName
                     fork = chain.spec.fork_name_at_slot(chain.slot())
@@ -818,6 +869,8 @@ def _make_handler(backend: ApiBackend):
                 return self._json(404, {"message": "route not found"})
             except ApiError as e:
                 return self._json(e.status, {"message": str(e)})
+            except ShedError as e:
+                return self._json(503, {"message": str(e)})
             except Exception as e:
                 return self._json(400, {"message": repr(e)})
 

@@ -186,6 +186,20 @@ CATALOG: dict[str, tuple[str, str]] = {
                  "node, 1M-validator envelope in bench.py stf mode)"),
     "stf_block_seconds":
         ("hist", "per_block_processing wall time for one imported block"),
+    # -- API serving tier (api/serving/, ISSUE 12) ------------------------
+    "api_requests_total":
+        ("counter", "Requests entering the serving tier"),
+    "api_cache_hits_total":
+        ("counter", "Serving-tier response-cache hits (pre-encoded "
+                    "bytes served without a backend call)"),
+    "api_cache_misses_total":
+        ("counter", "Serving-tier response-cache misses"),
+    "api_shed_total":
+        ("counter", "Requests shed by the serving tier's priority "
+                    "admission queue (HTTP 503)"),
+    "api_request_seconds":
+        ("hist", "Serving-tier request latency (api_request span: "
+                 "admission + cache/coalesce + backend)"),
     # -- JAX runtime accounting (obs/jax_accounting) ----------------------
     "jax_compile_total":
         ("counter", "XLA programs compiled at runtime (recompile storms "

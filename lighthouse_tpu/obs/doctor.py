@@ -87,6 +87,8 @@ _COSIGNALS = [
      "sync request deadlines expired"),
     ("sync_peer_quarantined_total", "delta",
      "sync peers quarantined"),
+    ("api_requests_total", "delta", "serving-tier requests served"),
+    ("api_shed_total", "delta", "serving-tier requests shed"),
 ]
 
 
@@ -146,6 +148,7 @@ def diagnose(doc: dict) -> dict:
         "chains": doc.get("chains") or [],
         "processors": doc.get("processors") or [],
         "sync": doc.get("sync"),
+        "serving": doc.get("serving"),
         "recovery": doc.get("recovery"),
         "incidents": [_correlate_incident(i, slots, series)
                       for i in incidents],
@@ -211,6 +214,28 @@ def render(diag: dict) -> str:
                 f"    rejected: peer {rj.get('peer')} "
                 f"[{_fmt_num(rj.get('start'))},"
                 f"+{_fmt_num(rj.get('count'))}) — {rj.get('reason')}")
+    # serving sections are post-ISSUE-12 dumps only; older dumps lack
+    # the key and render nothing (same contract as sync above)
+    for sv in diag.get("serving") or []:
+        if not isinstance(sv, dict):
+            continue
+        if "error" in sv:
+            lines.append(f"  serving: <{sv['error']}>")
+            continue
+        ratio = sv.get("cache_hit_ratio")
+        ratio_s = "-" if ratio is None else f"{ratio:.2f}"
+        lines.append(
+            f"  serving: {_fmt_num(sv.get('requests'))} requests, "
+            f"queue depth {_fmt_num(sv.get('queue_depth'))} "
+            f"(high water {_fmt_num(sv.get('queue_high_water'))}), "
+            f"cache hit ratio {ratio_s} "
+            f"({_fmt_num(sv.get('cache_entries'))} entries), "
+            f"{_fmt_num(sv.get('coalesced'))} coalesced, "
+            f"{_fmt_num(sv.get('shed_total'))} shed")
+        for sl in (sv.get("slowest") or [])[:3]:
+            lines.append(
+                f"    slowest: {sl.get('endpoint')} "
+                f"{_fmt_num(sl.get('worst_ms'))} ms worst")
     rec = diag.get("recovery")
     if rec:
         repairs = rec.get("repairs") or []

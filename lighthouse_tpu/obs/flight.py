@@ -15,6 +15,8 @@ offline:
 - a fork-choice head summary per registered chain
 - a sync summary per chain (state, in-flight request deadlines, peer
   backoff/quarantine, recent download-validation rejects)
+- a serving-tier summary per registered API tier (queue depth, cache
+  hit ratio, shed counts, slowest endpoints — ISSUE 12)
 - the trace-stamped ``log_buffer`` tail
 - every incident (open and resolved) plus current SLO status
 - the last store-recovery report (``chain.persistence.LAST_RECOVERY``),
@@ -106,6 +108,13 @@ def _sync_summary(chain) -> dict | None:
         return {"error": repr(exc)}
 
 
+def _serving_summary(tier) -> dict:
+    try:
+        return tier.snapshot()
+    except Exception as exc:
+        return {"error": repr(exc)}
+
+
 def _processor_summary(proc) -> dict:
     out: dict = {}
     try:
@@ -159,12 +168,15 @@ class FlightRecorder:
             sync = [s for s in (_sync_summary(c) for c in w.chains())
                     if s is not None]
             doc["sync"] = sync or None
+            serving = [_serving_summary(t) for t in w.servings()]
+            doc["serving"] = serving or None
         else:
             doc["incidents"] = []
             doc["slo"] = {}
             doc["chains"] = []
             doc["processors"] = []
             doc["sync"] = None
+            doc["serving"] = None
         doc["recovery"] = _recovery_report()
         doc["log_tail"] = global_log_buffer().tail(LOG_TAIL)
         return _json_safe(doc)

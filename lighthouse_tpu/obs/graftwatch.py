@@ -32,6 +32,7 @@ class Graftwatch:
         self.recorder = flight.FlightRecorder(self)
         self._chains: list = []          # weakrefs
         self._processors: list = []      # weakrefs
+        self._servings: list = []        # weakrefs (api serving tiers)
         self._lock = threading.Lock()
         self._last_slot: int | None = None
         self.auto_dump = False
@@ -51,6 +52,13 @@ class Graftwatch:
             if not any(r() is proc for r in self._processors):
                 self._processors.append(weakref.ref(proc))
 
+    def register_serving(self, tier) -> None:
+        with self._lock:
+            self._servings = [r for r in self._servings
+                              if r() is not None]
+            if not any(r() is tier for r in self._servings):
+                self._servings.append(weakref.ref(tier))
+
     def chains(self) -> list:
         with self._lock:
             return [c for c in (r() for r in self._chains)
@@ -60,6 +68,11 @@ class Graftwatch:
         with self._lock:
             return [p for p in (r() for r in self._processors)
                     if p is not None]
+
+    def servings(self) -> list:
+        with self._lock:
+            return [s for s in (r() for r in self._servings)
+                    if s is not None]
 
     # -- configuration ---------------------------------------------------
 
@@ -125,3 +138,7 @@ def register_chain(chain) -> None:
 
 def register_processor(proc) -> None:
     get().register_processor(proc)
+
+
+def register_serving(tier) -> None:
+    get().register_serving(tier)

@@ -31,6 +31,15 @@ hand:
                             != 0) the node keeps importing blocks; a
                             byzantine-majority peer pool may slow sync
                             down but must never stop it (ISSUE 11)
+``serving_p95``             Beacon-API serving-tier request p95 (the
+                            ``api_request`` graftscope span) stays
+                            inside budget — a cached/coalesced tier
+                            keeps VC hot-path reads fast under load
+                            (ISSUE 12)
+``serving_shed_rate``       the serving tier's admission queue sheds at
+                            most a budgeted fraction of requests per
+                            slot; sustained shedding above it means the
+                            tier is drowning, not just clipping bursts
 ==========================  ============================================
 """
 from __future__ import annotations
@@ -186,13 +195,41 @@ def _check_sync_progress(floor_blocks: float, stall_slots: int) -> Check:
     return check
 
 
+def _check_serving_p95(budget_s: float) -> Check:
+    def check(ctx: EvalContext):
+        p95 = ctx.sampler.latest("api_request_seconds.p95")
+        n = ctx.sampler.latest("api_request_seconds.count")
+        if p95 is None or not n:
+            return None, False, "no serving traffic this slot"
+        return p95, p95 > budget_s, f"serving p95 {p95 * 1e3:.1f}ms"
+    return check
+
+
+def _check_serving_shed_rate(budget_ratio: float,
+                             min_requests: int) -> Check:
+    """Shed fraction per slot (both are per-slot counter deltas)."""
+    def check(ctx: EvalContext):
+        reqs = ctx.sampler.latest("api_requests_total")
+        if reqs is None or reqs < min_requests:
+            return None, False, \
+                f"fewer than {min_requests} serving requests this slot"
+        shed = ctx.sampler.latest("api_shed_total") or 0.0
+        ratio = shed / reqs
+        return ratio, ratio > budget_ratio, \
+            f"shed {shed:.0f}/{reqs:.0f} requests ({ratio:.2f})"
+    return check
+
+
 def default_slos(pipeline_p95_s: float = 5.0,
                  head_lag_slots: int = 1,
                  compile_warmup_slots: int = 8,
                  shuffle_hit_ratio: float = 0.5,
                  shuffle_min_lookups: int = 20,
                  sync_floor_blocks: float = 1.0,
-                 sync_stall_slots: int = 3) -> list[SLO]:
+                 sync_stall_slots: int = 3,
+                 serving_p95_s: float = 0.5,
+                 serving_shed_ratio: float = 0.5,
+                 serving_min_requests: int = 8) -> list[SLO]:
     return [
         SLO("block_pipeline_p95", "beacon_block_pipeline_seconds",
             pipeline_p95_s,
@@ -227,6 +264,16 @@ def default_slos(pipeline_p95_s: float = 5.0,
             "slot; byzantine peers may slow sync but never stop it",
             _check_sync_progress(sync_floor_blocks, sync_stall_slots),
             resolve_after=2),
+        SLO("serving_p95", "api_request_seconds", serving_p95_s,
+            "Beacon-API serving-tier request p95 stays inside budget "
+            "(coalescing + response caches keep VC hot-path reads fast; "
+            "ISSUE 12)",
+            _check_serving_p95(serving_p95_s)),
+        SLO("serving_shed_rate", "api_shed_total", serving_shed_ratio,
+            "the serving tier's admission queue sheds at most a "
+            "budgeted fraction of requests per slot",
+            _check_serving_shed_rate(serving_shed_ratio,
+                                     serving_min_requests)),
     ]
 
 

@@ -67,6 +67,8 @@ SPAN_KINDS: dict[str, str] = {
     # mainnet-envelope STF (slot.py epoch boundary, bench.py stf mode)
     "stf_epoch": "stf_epoch_seconds",
     "stf_block": "stf_block_seconds",
+    # Beacon-API serving tier (api/serving/tier.py, ISSUE 12)
+    "api_request": "api_request_seconds",
 }
 
 _RING_CAPACITY = 4096

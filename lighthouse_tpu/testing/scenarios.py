@@ -250,7 +250,13 @@ def scenario_signature_flood(seed: int = 0) -> ScenarioResult:
     verification behind the priority processor with a lowered
     attestation queue cap: the batch verifier must take the per-item
     fallback split, the queue must shed load (counter + high-water), and
-    honest block flow must stay inside the envelope."""
+    honest block flow must stay inside the envelope.
+
+    Doubles as the serving-tier load test (ISSUE 12): while the flood
+    runs, a VC-fleet-shaped read load (duties + attestation_data every
+    slot) hammers the victim's API through the serving tier — the tier
+    must coalesce/cache the reads and the ``serving_p95`` SLO must be
+    clean at scenario end."""
     result = ScenarioResult("signature_flood", seed)
     spec = minimal_spec(altair_fork_epoch=0)
     spe = spec.preset.slots_per_epoch
@@ -287,6 +293,11 @@ def scenario_signature_flood(seed: int = 0) -> ScenarioResult:
         drop0 = counter_value("beacon_processor_work_dropped_total")
         fb0 = counter_value("beacon_batch_verify_fallback_total")
         flooded = 0
+        # the victim also serves a VC fleet while under flood: route the
+        # per-slot hot-path reads through the serving tier (keep a strong
+        # ref — the graftwatch registry is weak)
+        from ..api.serving import ServingTier
+        serving = ServingTier(victim.backend)
 
         def flood(slot: int) -> None:
             # structurally valid for the victim's inline checks; only
@@ -310,6 +321,11 @@ def scenario_signature_flood(seed: int = 0) -> ScenarioResult:
                                               for _ in range(95)))
                 src.network.publish_attestation(att, subnet=0)
                 flooded += 1
+            # the VC fleet's reads for this slot: identical per-slot
+            # requests the tier should collapse to one computation each
+            for _ in range(40):
+                serving.proposer_duties(slot // spe)
+                serving.attestation_data(slot, 0)
 
         with scenario_capture() as trace:
             net.run_slots(3, mid_slot=flood)
@@ -335,6 +351,18 @@ def scenario_signature_flood(seed: int = 0) -> ScenarioResult:
              f"{shed_incs[0].opened_slot if shed_incs else '-'}")
         _chk(result, "queue_high_water", proc.high_water >= CAP,
              f"queue high-water {proc.high_water} >= cap {CAP}")
+        ssnap = serving.snapshot()
+        _chk(result, "serving_coalesced",
+             ssnap["requests"] >= 200
+             and (ssnap["cache_hits"] + ssnap["coalesced"]) > 0,
+             f"{ssnap['requests']} VC reads served, "
+             f"{ssnap['cache_hits']} cache hits + "
+             f"{ssnap['coalesced']} coalesced (hit ratio "
+             f"{(ssnap['cache_hit_ratio'] or 0.0):.2f})")
+        sp = graftwatch.get().engine.status()["serving_p95"]
+        _chk(result, "serving_p95", sp["open_incident"] is None,
+             f"serving-tier p95 SLO clean at scenario end "
+             f"({sp['last_detail']})")
         flooder_score = victim.network.peers.score(
             net.nodes[1].network.transport.node_id)
         _chk(result, "flooder_downscored", flooder_score < -20.0,

@@ -1,0 +1,47 @@
+"""Seeded fixture for the serving-cache-discipline rule.
+
+True positives are tagged ``seeded``: router-shaped code calling the
+backend directly for endpoints the serving tier coalesces.  AST-scanned
+only, never imported.
+"""
+
+
+def build_routes(backend, serving):
+    return [
+        # bypassing the tier: every poll recomputes, nothing invalidates
+        ("/eth/v1/validator/attestation_data",
+         lambda m, q: backend.attestation_data(1, 0)),  # seeded
+        ("/eth/v1/validator/duties/proposer",
+         lambda m, q: backend.get_proposer_duties(3)),  # seeded
+        ("/eth/v1/beacon/headers",
+         lambda m, q: backend.headers(None, None)),  # seeded
+        ("/eth/v1/beacon/light_client/finality_update",
+         lambda m, q: backend.light_client_finality_update()),  # seeded
+        # sanctioned: the serving tier fronts the same endpoints
+        ("/eth/v1/validator/attestation_data/ok",
+         lambda m, q: serving.attestation_data(1, 0)),
+        ("/eth/v1/beacon/headers/ok",
+         lambda m, q: serving.headers(None, None)),
+    ]
+
+
+class Handler:
+    def __init__(self, backend, serving):
+        self.backend = backend
+        self.serving = serving
+        self.headers = {}
+
+    def do_post_duties(self, epoch, indices):
+        return self.backend.get_attester_duties(epoch, indices)  # seeded
+
+    def do_post_duties_ok(self, epoch, indices):
+        return self.serving.attester_duties(epoch, indices)
+
+    def negotiate(self):
+        # attribute access named like a coalesced endpoint on a
+        # non-backend receiver must stay silent
+        return self.headers.get("Accept", "")
+
+    def uncoalesced_ok(self, block_id):
+        # non-coalesced backend endpoints are out of the rule's scope
+        return self.backend.block_header(block_id)

@@ -243,6 +243,9 @@ class _StubWatch:
     def processors(self):
         return []
 
+    def servings(self):
+        return []
+
 
 def _storm_watch():
     """16 deterministic slots with a slot-8..11 storm (same shape as the

@@ -40,6 +40,7 @@ RULE_FIXTURE = {
     "compile-budget": "compile_budget_fix.py",
     "cow-discipline": "cow_discipline_fix.py",
     "store-atomicity": "store_atomicity_fix.py",
+    "serving-cache-discipline": "serving_cache_discipline_fix.py",
 }
 
 
