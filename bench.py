@@ -561,6 +561,92 @@ def _bench_fork_fanout(pre, n_forks=32, mutations_per_fork=4):
     }
 
 
+def bench_import_critpath():
+    """The REAL import pipeline at N validators: anchor a production
+    BeaconChain on the built state (checkpoint-sync builder path), drive
+    one worst-case block through the beacon processor's queue into
+    ``chain.process_block``, and extract the graftpath critical path —
+    queue-wait vs service time per stage (batch_signature,
+    state_transition, state_root, db_write).  This is the decomposition
+    PERF_MODEL §12 records and ROADMAP item 4 (pipelined import) plans
+    against; ``bench_state_transition`` times the bare STF, this times
+    what a node actually does between gossip arrival and new head."""
+    from lighthouse_tpu import obs
+    from lighthouse_tpu.beacon_processor import (
+        BeaconProcessor, Work, WorkType,
+    )
+    from lighthouse_tpu.chain.builder import BeaconChainBuilder
+    from lighthouse_tpu.chain.execution import MockExecutionLayer
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.obs import critpath
+    from lighthouse_tpu.specs.chain_spec import ForkName, mainnet_spec
+    from lighthouse_tpu.ssz import htr
+    from lighthouse_tpu.state_transition import (
+        VerifySignatures, per_block_processing,
+    )
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    n = int(os.environ.get("LHTPU_BENCH_STF_N", N_VALIDATORS))
+    slot = 100_000 * 32 + 2
+    bls.set_backend("fake")
+    spec = mainnet_spec()
+    state = build_beacon_state(n, slot)
+    T = state.T
+    sig = b"\x80" + b"\x00" * 95
+    # a real anchor block whose header IS the state's latest header, so
+    # the weak-subjectivity anchor and the import block's parent agree
+    anchor_body = T.BeaconBlockBody[ForkName.ALTAIR](
+        randao_reveal=sig, eth1_data=state.eth1_data,
+        graffiti=b"\x00" * 32)
+    anchor = T.BeaconBlock[ForkName.ALTAIR](
+        slot=slot - 1, proposer_index=0, parent_root=b"\x11" * 32,
+        state_root=b"\x22" * 32, body=anchor_body)
+    state.latest_block_header = T.BeaconBlockHeader(
+        slot=slot - 1, proposer_index=0, parent_root=b"\x11" * 32,
+        state_root=b"\x22" * 32, body_root=htr(anchor_body))
+    signed_anchor = T.SignedBeaconBlock[ForkName.ALTAIR](
+        message=anchor, signature=sig)
+    sb = _build_import_block(state)
+    # untimed pre-pass fills the block's real post-state root (the
+    # import verifies it) and primes caches like the STF bench does
+    post = state.copy()
+    per_block_processing(post, sb, VerifySignatures.FALSE)
+    sb.message.state_root = post.hash_tree_root()
+    del post
+    chain = (BeaconChainBuilder(spec)
+             .weak_subjectivity_anchor(state, signed_anchor)
+             .slot_clock(ManualSlotClock(0, spec.seconds_per_slot,
+                                         current_slot=slot))
+             .execution_layer(MockExecutionLayer())
+             .build())
+    proc = BeaconProcessor(num_workers=2)
+    proc.start()
+    try:
+        proc.submit(Work(kind=WorkType.GOSSIP_BLOCK,
+                         run=lambda: chain.process_block(sb)))
+        if not proc.wait_idle(timeout=600):
+            raise RuntimeError("import did not finish inside 600s")
+    finally:
+        proc.stop()
+    comp = critpath.worst_component(obs.snapshot(),
+                                    kinds=("block_import",))
+    if comp is None:
+        raise RuntimeError("no block_import trace recorded")
+    rep = critpath.component_report(comp)
+    qwait = sum(r["queue_wait_ms"] for r in rep["stages"].values())
+    return {
+        "n_validators": n,
+        "sig_backend": "fake",
+        "total_ms": rep["total_ms"],
+        "terminal": (rep["terminal"] or {}).get("kind"),
+        "queue_wait_ms": round(qwait, 3),
+        "import_stages": {k: rep["stages"][k]
+                          for k in critpath.IMPORT_STAGES
+                          if k in rep["stages"]},
+        "stages": rep["stages"],
+    }
+
+
 def _measured_host_baseline():
     """Measured single-pairing-check cost on the native C++ backend, scaled
     to the reference's 4-core node.  Returns (sigs_per_sec, source) where
@@ -637,6 +723,14 @@ def child_main():
             "state_copy_gate_pass":
                 stf["stages"]["state_copy_ms"] <= 60.0,
         }
+        # graftpath: the real import pipeline's critical path at the
+        # same validator count (PERF_MODEL §12); never let a failure
+        # here cost the STF record itself
+        if os.environ.get("LHTPU_BENCH_CRITPATH", "1") != "0":
+            try:
+                rec["import_critpath_1m"] = bench_import_critpath()
+            except Exception as exc:
+                rec["import_critpath_1m"] = {"error": repr(exc)}
     elif mode == "serve":
         sv = bench_serving()
         rec = {
@@ -816,6 +910,16 @@ def _against_main(argv):
     report = compare_records(old, new, limit)
     report["old_file"] = old_path
     report["new_source"] = new_source
+    if report["regressions"]:
+        # point at the stage-level attribution workflow: capture both
+        # versions with --trace, then diff the captures (graftpath)
+        report["differential_profile"] = (
+            "attribute the regression per stage: run both versions "
+            "with `python bench.py --trace`, keep the old "
+            "BENCH_TRACE_<mode>.json, then "
+            "`python tools/obs/diff.py OLD_TRACE.json "
+            "BENCH_TRACE_<mode>.json` shows which stage's critical-"
+            "path self-time moved")
     print(json.dumps(report, indent=1))
     sys.exit(0 if report["ok"] else 1)
 
@@ -1019,6 +1123,8 @@ def main():
                         stf_rec.get("state_copy_gate_ms")
                     rec["state_copy_gate_pass"] = \
                         stf_rec.get("state_copy_gate_pass")
+                    rec["import_critpath_1m"] = \
+                        stf_rec.get("import_critpath_1m")
                 mxu_rec = _mxu_record(force_cpu)
                 if mxu_rec is not None and mxu_rec.get("value"):
                     rec["mont_mul_per_sec"] = \

@@ -22,6 +22,15 @@ Mechanics (v2, on the shared interprocedural engine):
    are static Python values under tracing — the classic true negative).
    The taint pass runs for *every* function in the cached per-file
    stage; the cross-file stage keeps only the jit-reachable findings.
+
+The rule also owns the graftpath causal-scope discipline (ISSUE 13):
+a delivery callback — any function with a parameter named ``peer``,
+the gossip/RPC handler convention — that opens a graftscope span must
+attach a causal identity (``message_id``/``block_root``/``root``/
+``req_id``, obs/causal.py CAUSAL_KEYS) as a span kwarg or via
+``annotate(...)``, or the cross-node stitcher can never join its trace
+to the publisher's.  This check is per-module (no reachability gate)
+and pins its violation to the bare ``span(...)`` call line.
 """
 from __future__ import annotations
 
@@ -59,6 +68,44 @@ _SANCTIONED_TRACE_CALLS = {"span", "annotate", "record_event",
                            "host_readback", "account_transfer"}
 #: modules never entered by the reachability BFS
 _SANCTIONED_MODULE_PARTS = ("/obs/",)
+#: causal span attrs (obs/causal.py CAUSAL_KEYS) — delivery callbacks
+#: must stamp one so the cross-node stitcher can join their traces
+_CAUSAL_KEYS = {"message_id", "block_root", "root", "req_id"}
+#: the gossip/RPC handler convention: first non-self parameter is `peer`
+_DELIVERY_PARAM = "peer"
+
+
+def _causal_violations(rule_name: str, mod: Module, qualname: str,
+                       fn: ast.FunctionDef) -> list:
+    """Bare ``span(...)`` calls inside a delivery callback (a function
+    with a ``peer`` parameter).  One causal kwarg on any span, or one
+    ``annotate(...)`` with a causal key, clears the whole function —
+    the scope attaches to the trace either way."""
+    args = fn.args
+    params = {a.arg for a in
+              args.posonlyargs + args.args + args.kwonlyargs}
+    if _DELIVERY_PARAM not in params:
+        return []
+    bare_spans: list[ast.Call] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        last = name.split(".")[-1] if name else ""
+        kw = {k.arg for k in node.keywords if k.arg}
+        if last == "span":
+            if kw & _CAUSAL_KEYS:
+                return []
+            bare_spans.append(node)
+        elif last == "annotate" and kw & _CAUSAL_KEYS:
+            return []
+    return [mod.violation(
+        rule_name, node,
+        "delivery callback opens a span with no causal scope "
+        "(message_id/block_root/root/req_id) — the cross-node "
+        "stitcher (obs/causal.py) cannot join this trace to its "
+        "publisher; stamp the id as a span kwarg or annotate() it",
+        symbol=qualname) for node in bare_spans]
 
 
 def _func_key(mod: Module, qualname: str) -> tuple[str, str]:
@@ -272,11 +319,15 @@ class TraceSafetyRule(Rule):
         keeps this stage independent of the rest of the tree."""
         idx = _FuncIndex(module)
         cands: dict[str, list] = {}
+        causal: list = []
         for qn, fn in idx.funcs.items():
             checker = _TaintChecker(self.name, module, qn, fn)
             if checker.violations:
                 cands[qn] = [v.to_json() for v in checker.violations]
-        return {"roots": sorted(idx.roots), "cands": cands}
+            causal.extend(v.to_json() for v in _causal_violations(
+                self.name, module, qn, fn))
+        return {"roots": sorted(idx.roots), "cands": cands,
+                "causal": causal}
 
     def finalize_project(self, ctx) -> list:
         data = ctx.data_for(self.name)
@@ -294,5 +345,10 @@ class TraceSafetyRule(Rule):
             if d is None:
                 continue
             for v in d["cands"].get(qn, ()):
+                out.append(Violation(**v))
+        # causal-scope findings are per-module truths, emitted without a
+        # reachability gate (.get: caches from before the check existed)
+        for rel in sorted(data):
+            for v in data[rel].get("causal", ()):
                 out.append(Violation(**v))
         return out

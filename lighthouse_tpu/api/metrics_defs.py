@@ -65,6 +65,38 @@ CATALOG: dict[str, tuple[str, str]] = {
     "gossipsub_validation_reject_total":
         ("counter", "Gossip rejected"),
     "gossipsub_mesh_peers": ("gauge", "Mesh size across topics"),
+    "gossipsub_publish_seconds":
+        ("hist", "Block publish fan-out latency (gossip_publish span; "
+                 "carries the eth2 content-derived message_id)"),
+    "gossipsub_deliver_seconds":
+        ("hist", "Aggregate delivery-callback latency (gossip_deliver "
+                 "span; block deliveries are traced by the "
+                 "block_pipeline span instead)"),
+    "rpc_request_seconds":
+        ("hist", "Req/resp requester-side round-trip (rpc_request span, "
+                 "content-derived req_id shared with the responder)"),
+    "rpc_serve_seconds":
+        ("hist", "Req/resp responder-side handler latency (rpc_serve "
+                 "span, same content-derived req_id)"),
+    # -- graftpath propagation + stage occupancy (obs/causal.py) ----------
+    "block_propagation_seconds":
+        ("hist", "Block publish -> import on a receiving node (stitched "
+                 "by block root across the in-process network)"),
+    "attestation_propagation_seconds":
+        ("hist", "Aggregate publish -> delivery on a receiving node "
+                 "(stitched by gossip message-id)"),
+    "import_stage_busy_fraction_signature":
+        ("gauge", "Fraction of the last slot spent in batch signature "
+                  "verification (obs/occupancy.py)"),
+    "import_stage_busy_fraction_state_transition":
+        ("gauge", "Fraction of the last slot spent in per-block state "
+                  "transition"),
+    "import_stage_busy_fraction_merkleization":
+        ("gauge", "Fraction of the last slot spent computing post-state "
+                  "roots"),
+    "import_stage_busy_fraction_persistence":
+        ("gauge", "Fraction of the last slot spent persisting blocks and "
+                  "states"),
     "gossipsub_idontwant_sent_total":
         ("counter", "IDONTWANT control messages sent"),
     "libp2p_peers": ("gauge", "Connected libp2p peers"),

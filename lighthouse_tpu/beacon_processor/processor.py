@@ -4,6 +4,7 @@ from __future__ import annotations
 import enum
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -58,6 +59,10 @@ class Work:
     #: (trace_id, span_id) captured at submit time so the worker's spans
     #: join the submitting thread's trace (graftscope queue-hop rule)
     trace_ctx: Any = None
+    #: perf_counter at submit — the worker's span reports the queue wait
+    #: (enqueue -> execution start) so the critical path can split
+    #: queue-wait from service time (obs/critpath.py)
+    enqueued_at: float = 0.0
 
 
 class BeaconProcessor:
@@ -111,6 +116,8 @@ class BeaconProcessor:
     def submit(self, work: Work) -> bool:
         if work.trace_ctx is None:
             work.trace_ctx = tracing.capture()
+        if not work.enqueued_at:
+            work.enqueued_at = time.perf_counter()
         with self._lock:
             q = self.queues[work.kind]
             cap = self.caps.get(work.kind, 4096)
@@ -170,7 +177,10 @@ class BeaconProcessor:
         # item's context (they are one fused device call anyway)
         with tracing.attach(first.trace_ctx), \
                 tracing.span("processor_work", work_kind=first.kind.name,
-                             batch=batch):
+                             batch=batch) as s:
+            if first.enqueued_at:
+                s.annotate(queue_wait_s=round(
+                    max(0.0, s.start - first.enqueued_at), 9))
             self._execute_inner(work)
 
     def _execute_inner(self, work) -> None:

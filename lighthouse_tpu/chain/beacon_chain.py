@@ -21,7 +21,7 @@ import numpy as np
 from ..containers import get_types
 from ..containers.state import BeaconState
 from ..crypto import bls
-from ..obs import tracing
+from ..obs import causal, tracing
 from ..fork_choice import ForkChoice
 from ..operation_pool import OperationPool
 from ..specs.chain_spec import ChainSpec, ForkName
@@ -316,7 +316,11 @@ class BeaconChain:
                     proposal_already_verified)
             # state_transition + state_root spans live inside
             ep = blk_verify.into_execution_pending(self, sv)
-            return self._finish_process_block(block, block_root, ep)
+            imported = self._finish_process_block(block, block_root, ep)
+        # propagation clock: a lookup hit means another node published
+        # this root (the proposer imports before publishing — a miss)
+        causal.tracker().on_block_imported(block_root)
+        return imported
 
     def process_gossip_block(self, signed_block) -> bytes:
         """Canonical gossip entry: gossip verification + full import as

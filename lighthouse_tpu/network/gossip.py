@@ -114,6 +114,12 @@ class GossipEngine:
 
     def __init__(self, transport, fork_digest: bytes):
         self.transport = transport
+        # graftpath node attribution: every causal span this engine opens
+        # is stamped with the node's label so cross-node stitching can
+        # tell the fleet apart (the network service overrides this with
+        # the simulator's n<i> label when it has one)
+        self.node_label = (getattr(transport, "label", None)
+                           or str(getattr(transport, "node_id", ""))[:8])
         self.fork_digest = fork_digest
         self.subscriptions: set[str] = set()      # bare names
         self.validator = lambda topic, data: ("accept", None)
@@ -237,8 +243,26 @@ class GossipEngine:
                              data=snappy.compress_block(data))
 
     def publish(self, topic: str, data: bytes,
-                exclude_peer: str | None = None) -> int:
+                exclude_peer: str | None = None,
+                root: bytes | None = None) -> int:
         mid = self._message_id(topic, data)
+        if topic == Topic.BLOCK:
+            # causal publish span: the content-derived message id is the
+            # cross-node stitch key (obs/causal.py); the origin publish
+            # (service.publish_block) also passes the block root so the
+            # sync-path import edge has an anchor — mesh forwards don't
+            attrs = {"topic": topic, "message_id": mid,
+                     "node": self.node_label}
+            if root is not None:
+                attrs["root"] = root
+            cm = tracing.span("gossip_publish", **attrs)
+        else:
+            cm = tracing.attach(None)
+        with cm:
+            return self._fan_out(topic, data, mid, exclude_peer)
+
+    def _fan_out(self, topic: str, data: bytes, mid: bytes,
+                        exclude_peer: str | None) -> int:
         self._mark_seen(mid)
         self._cache_put(mid, topic, data)
         _count("gossipsub_messages_published_total")
@@ -336,10 +360,21 @@ class GossipEngine:
         # one slot-anchored trace per block message: validation (which
         # runs gossip_verify) and delivery (which submits processor work
         # carrying this context) share the trace id, so the block's path
-        # from wire to db-write is a single graftscope trace
-        is_block = topic == "beacon_block"
-        with tracing.span("block_pipeline", topic=topic) if is_block \
-                else tracing.attach(None):
+        # from wire to db-write is a single graftscope trace.  The span
+        # carries the causal scope (content-derived message id + node
+        # label) so obs/causal.py can stitch it to the publisher's span
+        # on another node; aggregates get a lighter gossip_deliver span
+        # (per-attestation subnet traffic stays span-free — a flood
+        # would churn the 4096-span ring out from under the envelopes).
+        if topic == Topic.BLOCK:
+            cm = tracing.span("block_pipeline", topic=topic,
+                              message_id=mid, node=self.node_label)
+        elif topic == Topic.AGGREGATE:
+            cm = tracing.span("gossip_deliver", topic=topic,
+                              message_id=mid, node=self.node_label)
+        else:
+            cm = tracing.attach(None)
+        with cm:
             result, ctx = self.validator(topic, data)
             _count(f"gossipsub_validation_{result}_total")
             self.on_validation_result(peer, topic, result)

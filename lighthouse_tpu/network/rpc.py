@@ -20,11 +20,13 @@ per (peer, protocol) as in rpc/rate_limiter.rs.
 """
 from __future__ import annotations
 
+import hashlib
 import struct
 import threading
 import time
 from dataclasses import dataclass
 
+from ..obs import tracing
 from . import snappy
 from .multistream import write_uvarint
 from .yamux import Stream, YamuxEOF, YamuxError
@@ -329,11 +331,24 @@ def _read_stream_uvarint(stream: Stream, timeout: float) -> int:
             raise ValueError("varint overflow")
 
 
+def _req_id(spec, req_ssz: bytes) -> str:
+    """Content-derived request id: both sides of a stream hold the exact
+    same request bytes (the requester encodes them, the responder reads
+    them), so hashing protocol id + payload yields a shared identifier
+    WITHOUT any wire change — graftpath stitches rpc_request/rpc_serve
+    spans across nodes on it."""
+    if spec.name == "metadata":
+        req_ssz = b""              # responder never reads a payload
+    return hashlib.sha256(spec.id.encode() + req_ssz).hexdigest()[:16]
+
+
 class RpcHandler:
     """Stream-per-request req/resp engine over the libp2p transport."""
 
     def __init__(self, transport):
         self.transport = transport
+        self.node_label = (getattr(transport, "label", None)
+                           or str(getattr(transport, "node_id", ""))[:8])
         self.handlers: dict[str, callable] = {}
         self.rate_limiter = RateLimiter()
         self.on_rate_limited = lambda peer, protocol: None
@@ -350,20 +365,24 @@ class RpcHandler:
                 timeout: float = 10.0):
         spec = SPECS[protocol]
         req_ssz = spec.enc_req(payload or {})
-        try:
-            stream, _ = peer.open_protocol([spec.id], timeout)
-        except Exception as e:
-            raise TimeoutError(f"rpc {protocol}: open failed: {e}") from None
-        try:
-            if req_ssz or spec.name != "metadata":
-                write_payload(stream, req_ssz)
-            stream.close()                      # FIN: request complete
-            if spec.chunked:
-                return self._read_chunks(spec, stream, timeout)
-            return self._read_single(spec, stream, timeout)
-        finally:
-            if not stream.reset:
-                stream.close()
+        with tracing.span("rpc_request", protocol=spec.name,
+                          req_id=_req_id(spec, req_ssz),
+                          node=self.node_label):
+            try:
+                stream, _ = peer.open_protocol([spec.id], timeout)
+            except Exception as e:
+                raise TimeoutError(
+                    f"rpc {protocol}: open failed: {e}") from None
+            try:
+                if req_ssz or spec.name != "metadata":
+                    write_payload(stream, req_ssz)
+                stream.close()                  # FIN: request complete
+                if spec.chunked:
+                    return self._read_chunks(spec, stream, timeout)
+                return self._read_single(spec, stream, timeout)
+            finally:
+                if not stream.reset:
+                    stream.close()
 
     def _read_result_byte(self, spec, stream, timeout: float) -> int | None:
         """-> result code, or None on CLEAN EOF only; a stall or RST
@@ -429,7 +448,10 @@ class RpcHandler:
             stream.close()
             return
         try:
-            resp = handler(peer, req)
+            with tracing.span("rpc_serve", protocol=spec.name,
+                              req_id=_req_id(spec, req_ssz),
+                              node=self.node_label):
+                resp = handler(peer, req)
         except Exception:
             stream.write(bytes([RESULT_SERVER_ERROR]))
             write_payload(stream, b"server error")

@@ -11,6 +11,7 @@ import threading
 from dataclasses import dataclass
 
 from ..chain.errors import AttestationError, BlockError
+from ..obs import causal
 from ..specs.chain_spec import compute_fork_digest
 from ..ssz import deserialize, htr, serialize
 from ..utils.threads import ThreadGroup
@@ -62,13 +63,16 @@ class DeferredAttestation:
 
 class NetworkService:
     def __init__(self, chain, config: NetworkConfig | None = None,
-                 processor=None, transport_factory=None):
+                 processor=None, transport_factory=None,
+                 label: str | None = None):
         """`processor`: optional BeaconProcessor — accepted gossip is then
         imported through its priority queues (with attestation batching)
         instead of inline on the socket reader thread.
         `transport_factory`: optional (host, port) -> Transport hook so a
         fault-injecting transport (network/faults.py) can be swapped in
-        without subclassing the service."""
+        without subclassing the service.
+        `label`: graftpath node label stamped on every causal span this
+        node opens (defaults to the transport's label / node-id prefix)."""
         self.chain = chain
         self.config = config or NetworkConfig()
         self.processor = processor
@@ -91,6 +95,10 @@ class NetworkService:
             chain.genesis_validators_root)
         self.gossip = GossipEngine(self.transport, digest)
         self.rpc = RpcHandler(self.transport)
+        if label is not None:
+            self.gossip.node_label = label
+            self.rpc.node_label = label
+        self.node_label = self.gossip.node_label
         self.peers = PeerManager(self.config.target_peers)
         self.sync = SyncManager(chain, self.rpc, self.peers)
 
@@ -496,6 +504,11 @@ class NetworkService:
         (network_beacon_processor role), else import inline."""
         if ctx is None or self._stopping:
             return
+        if topic == Topic.AGGREGATE:
+            # publish->deliver latency, keyed by the content-derived
+            # message id the publisher stamped (obs/causal.py)
+            causal.tracker().on_attestation_delivered(
+                self.gossip._message_id(topic, data))
         if self.processor is not None:
             from ..beacon_processor import Work, WorkType
             if topic == Topic.BLOCK:
@@ -596,11 +609,21 @@ class NetworkService:
 
     def publish_block(self, signed_block) -> None:
         data = serialize(type(signed_block).ssz_type, signed_block)
-        self.gossip.publish(Topic.BLOCK, data)
+        root = htr(signed_block.message)
+        # propagation clock starts at the origin publish; every other
+        # node's import of this root observes block_propagation_seconds
+        causal.tracker().on_block_published(root)
+        self.gossip.publish(Topic.BLOCK, data, root=root)
 
     def publish_attestation(self, attestation, subnet: int = 0) -> None:
         data = serialize(type(attestation).ssz_type, attestation)
         self.gossip.publish(Topic.attestation_subnet(subnet), data)
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        data = serialize(type(signed_aggregate).ssz_type, signed_aggregate)
+        causal.tracker().on_attestation_published(
+            self.gossip._message_id(Topic.AGGREGATE, data))
+        self.gossip.publish(Topic.AGGREGATE, data)
 
     def publish_sync_committee_message(self, msg, subnet: int = 0) -> None:
         data = serialize(type(msg).ssz_type, msg)

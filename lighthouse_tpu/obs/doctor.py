@@ -149,6 +149,7 @@ def diagnose(doc: dict) -> dict:
         "processors": doc.get("processors") or [],
         "sync": doc.get("sync"),
         "serving": doc.get("serving"),
+        "critpath": doc.get("critpath"),
         "recovery": doc.get("recovery"),
         "incidents": [_correlate_incident(i, slots, series)
                       for i in incidents],
@@ -236,6 +237,20 @@ def render(diag: dict) -> str:
             lines.append(
                 f"    slowest: {sl.get('endpoint')} "
                 f"{_fmt_num(sl.get('worst_ms'))} ms worst")
+    # critpath sections are post-ISSUE-13 dumps only; older dumps lack
+    # the key and render nothing (same contract as sync above)
+    cp = diag.get("critpath")
+    if isinstance(cp, dict):
+        if "error" in cp:
+            lines.append(f"  critical path: <{cp['error']}>")
+        else:
+            from .critpath import render_critical_path
+            title = "worst block trace"
+            nodes = cp.get("nodes") or []
+            if nodes:
+                title += f" across {len(nodes)} node(s)"
+            for ln in render_critical_path(cp, title).splitlines():
+                lines.append("  " + ln)
     rec = diag.get("recovery")
     if rec:
         repairs = rec.get("repairs") or []

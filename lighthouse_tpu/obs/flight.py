@@ -9,6 +9,8 @@ bundling everything `tools/obs/doctor.py` needs to correlate a breach
 offline:
 
 - the recent span ring as a Perfetto-loadable Chrome trace
+- the critical path of the window's worst block trace (obs/critpath.py,
+  stitched cross-node when the ring holds an in-process fleet)
 - the full graftwatch time-series window
 - ``jax_accounting.snapshot()`` (compiles, compile seconds, transfers)
 - beacon-processor queue depths / drop / high-water counts
@@ -57,6 +59,27 @@ def _json_safe(obj):
     if isinstance(obj, (str, int, bool)) or obj is None:
         return obj
     return repr(obj)
+
+
+def _critpath_summary() -> dict | None:
+    """Critical path of the worst block trace in the span ring — the
+    incident window's 'what did the latency wait on' answer, stitched
+    across nodes when the ring holds a whole in-process fleet
+    (graftpath, ISSUE 13).  None when the ring has no spans."""
+    from . import critpath
+    try:
+        spans = tracing.snapshot()
+        comp = critpath.worst_component(spans)
+        if comp is None:
+            return None
+        rep = critpath.component_report(comp)
+        if not rep["segments"]:
+            return None
+        rep["nodes"] = comp.node_labels()
+        rep["block_roots"] = comp.block_roots()
+        return rep
+    except Exception as exc:  # pragma: no cover - never block a dump
+        return {"error": repr(exc)}
 
 
 def _recovery_report():
@@ -157,6 +180,7 @@ class FlightRecorder:
             doc["slot"] = None
             doc["timeseries"] = {"window": 0, "slots": [], "series": {}}
         doc["chrome_trace"] = tracing.chrome_trace()
+        doc["critpath"] = _critpath_summary()
         doc["jax"] = jax_accounting.snapshot()
         if w is not None:
             doc["incidents"] = [i.to_dict()

@@ -22,7 +22,7 @@ from __future__ import annotations
 import threading
 import weakref
 
-from . import flight, slo, timeseries
+from . import flight, occupancy, slo, timeseries
 
 
 class Graftwatch:
@@ -89,6 +89,7 @@ class Graftwatch:
             self._last_slot = None
         self.sampler.reset()
         self.engine.reset()
+        occupancy.get().reset()
 
     # -- the per-slot tick ----------------------------------------------
 
@@ -105,6 +106,9 @@ class Graftwatch:
             elif self._last_slot == slot:
                 return
             self._last_slot = slot
+        # fold stage busy-seconds into the occupancy gauges before the
+        # snapshot so the sampler rows carry this slot's fractions
+        occupancy.publish()
         self.sampler.sample(slot)
         opened = self.engine.evaluate(slot, tuple(self.chains()))
         if opened and self.auto_dump:

@@ -40,6 +40,9 @@ hand:
                             most a budgeted fraction of requests per
                             slot; sustained shedding above it means the
                             tier is drowning, not just clipping bursts
+``propagation_p95``         publish -> import block propagation across
+                            the in-process fleet (graftpath's stitched
+                            lens on gossip health, ISSUE 13)
 ==========================  ============================================
 """
 from __future__ import annotations
@@ -195,6 +198,16 @@ def _check_sync_progress(floor_blocks: float, stall_slots: int) -> Check:
     return check
 
 
+def _check_propagation_p95(budget_s: float) -> Check:
+    def check(ctx: EvalContext):
+        p95 = ctx.sampler.latest("block_propagation_seconds.p95")
+        n = ctx.sampler.latest("block_propagation_seconds.count")
+        if p95 is None or not n:
+            return None, False, "no propagation traffic this slot"
+        return p95, p95 > budget_s, f"propagation p95 {p95 * 1e3:.1f}ms"
+    return check
+
+
 def _check_serving_p95(budget_s: float) -> Check:
     def check(ctx: EvalContext):
         p95 = ctx.sampler.latest("api_request_seconds.p95")
@@ -229,7 +242,10 @@ def default_slos(pipeline_p95_s: float = 5.0,
                  sync_stall_slots: int = 3,
                  serving_p95_s: float = 0.5,
                  serving_shed_ratio: float = 0.5,
-                 serving_min_requests: int = 8) -> list[SLO]:
+                 serving_min_requests: int = 8,
+                 # propagation subsumes the whole verify->import pipeline,
+                 # so its budget tracks pipeline_p95_s, not gossip alone
+                 propagation_p95_s: float = 5.0) -> list[SLO]:
     return [
         SLO("block_pipeline_p95", "beacon_block_pipeline_seconds",
             pipeline_p95_s,
@@ -274,6 +290,11 @@ def default_slos(pipeline_p95_s: float = 5.0,
             "budgeted fraction of requests per slot",
             _check_serving_shed_rate(serving_shed_ratio,
                                      serving_min_requests)),
+        SLO("propagation_p95", "block_propagation_seconds",
+            propagation_p95_s,
+            "publish -> import block propagation p95 across the fleet "
+            "stays inside budget (graftpath, ISSUE 13)",
+            _check_propagation_p95(propagation_p95_s)),
     ]
 
 

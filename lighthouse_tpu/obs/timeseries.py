@@ -27,6 +27,8 @@ import threading
 
 import numpy as np
 
+from . import occupancy
+
 #: ring length, in slots (~2 epochs of mainnet at 32 slots/epoch on
 #: either side of any incident a flight dump wants to explain)
 DEFAULT_WINDOW = 128
@@ -213,6 +215,9 @@ def get_sampler() -> SlotSampler:
 
 def record(kind: str, name: str, value: float) -> None:
     """Feed hook called by ``api.metrics`` on every metric touch."""
+    if kind not in ("counter", "gauge"):
+        # import-stage busy-seconds tap (graftpath occupancy gauges)
+        occupancy.on_observation(name, value)
     _SAMPLER.record(kind, name, value)
 
 

@@ -69,6 +69,11 @@ SPAN_KINDS: dict[str, str] = {
     "stf_block": "stf_block_seconds",
     # Beacon-API serving tier (api/serving/tier.py, ISSUE 12)
     "api_request": "api_request_seconds",
+    # graftpath cross-node causal annotation points (obs/causal.py)
+    "gossip_publish": "gossipsub_publish_seconds",
+    "gossip_deliver": "gossipsub_deliver_seconds",
+    "rpc_request": "rpc_request_seconds",
+    "rpc_serve": "rpc_serve_seconds",
 }
 
 _RING_CAPACITY = 4096

@@ -30,7 +30,7 @@ from ..api.metrics import counter_value
 from ..network.faults import FaultInjector, PeerBehavior
 from ..network.sync import range_sync as range_sync_mod
 from ..obs import doctor as flight_doctor
-from ..obs import graftwatch
+from ..obs import graftwatch, timeseries
 from ..obs.capture import ScenarioTrace, scenario_capture
 from ..specs import minimal_spec
 from ..ssz import htr
@@ -102,12 +102,16 @@ def _chk(result: ScenarioResult, name: str, ok: bool, detail: str) -> bool:
 
 
 def _envelope_checks(result: ScenarioResult, net: LocalNetwork,
-                     trace: ScenarioTrace, max_head_lag: int = 1) -> None:
+                     trace: ScenarioTrace, max_head_lag: int = 1,
+                     require_propagation: bool = False) -> None:
     """The degradation envelope every scenario ends on, evaluated by the
     graftwatch SLO engine — the same objectives a live node watches each
     slot: blocks kept flowing through the pipeline, the pipeline-p95
     objective never breached, and the head-lag objective is clean (any
-    mid-scenario incident resolved) by scenario end."""
+    mid-scenario incident resolved) by scenario end.  With
+    ``require_propagation`` the graftpath publish->import propagation
+    histogram must have seen traffic and the propagation_p95 SLO must be
+    clean (ISSUE 13)."""
     _chk(result, "pipeline_active", trace.count("block_pipeline") > 0,
          f"{trace.count('block_pipeline')} gossip block pipelines traced")
     status = graftwatch.get().engine.status()
@@ -124,6 +128,24 @@ def _envelope_checks(result: ScenarioResult, net: LocalNetwork,
          head["open_incident"] is None and lag <= max_head_lag,
          f"SLO clean ({head['last_detail']}); live lag {lag} slots "
          f"(max {max_head_lag})")
+    if require_propagation:
+        _propagation_check(result, status)
+
+
+def _propagation_check(result: ScenarioResult, status: dict) -> None:
+    """Assert the graftpath publish->import propagation histogram saw
+    traffic over the scenario and the propagation_p95 SLO ended clean."""
+    import numpy as np
+    sampler = timeseries.get_sampler()
+    _slots, counts = sampler.series("block_propagation_seconds.count")
+    total = float(np.nansum(counts)) if counts.size else 0.0
+    p95_s = sampler.latest("block_propagation_seconds.p95")
+    prop = status["propagation_p95"]
+    _chk(result, "propagation_p95",
+         prop["open_incident"] is None and total > 0,
+         f"SLO clean ({prop['last_detail']}); {total:.0f} stitched "
+         f"publish->import propagations sampled, last-slot p95 "
+         f"{(p95_s or 0.0) * 1000.0:.1f}ms")
 
 
 def _chain_blocks(chain, max_back: int = 128):
@@ -372,7 +394,7 @@ def scenario_signature_flood(seed: int = 0) -> ScenarioResult:
                  for n in net.live_nodes}
         _chk(result, "converged", len(heads) == 1,
              f"{len(heads)} distinct heads after the flood")
-        _envelope_checks(result, net, trace)
+        _envelope_checks(result, net, trace, require_propagation=True)
     finally:
         net.stop()
     return result
@@ -471,6 +493,10 @@ def scenario_partition_heal(seed: int = 0) -> ScenarioResult:
                  f"incident(s) with "
                  f"{sum(len(d['correlations']) for d in lag_diags)} "
                  "co-occurring signals")
+        # graftpath: blocks still crossed the (healed) mesh under
+        # observation, and the propagation objective ended clean — the
+        # second scenario envelope asserting through propagation_p95
+        _propagation_check(result, watch.engine.status())
     finally:
         watch.configure(auto_dump=False)
         watch.recorder.dump_dir = None

@@ -63,6 +63,10 @@ class GossipingBackend(ApiBackend):
         super().publish_attestation(attestation)
         self.network.publish_attestation(attestation)
 
+    def publish_aggregate(self, signed_aggregate) -> None:
+        super().publish_aggregate(signed_aggregate)
+        self.network.publish_aggregate(signed_aggregate)
+
     def publish_sync_committee_message(self, msg) -> None:
         super().publish_sync_committee_message(msg)
         self.network.publish_sync_committee_message(msg)
@@ -163,7 +167,8 @@ class LocalNetwork:
                 host, port, security=self.security, injector=inj,
                 label=label)
         net = NetworkService(chain, cfg, processor=processor,
-                             transport_factory=transport_factory)
+                             transport_factory=transport_factory,
+                             label=label)
         backend = GossipingBackend(chain, net)
         net.start()
         node = LocalNode(harness, net, backend)
@@ -454,6 +459,20 @@ class LocalNetwork:
                 n.api_server.stop()
 
 
+def write_stitched_trace(path: str, spans=None) -> str:
+    """Dump the span ring (the whole in-process fleet shares one) as a
+    stitched Chrome trace: one pid per node label, graftpath flow arrows
+    for the cross-node publish->deliver/import edges — loads in Perfetto
+    as a fleet, not a soup (obs/causal.py, ISSUE 13)."""
+    import json
+    from ..obs import causal, tracing
+    doc = causal.stitched_chrome_trace(
+        tracing.snapshot() if spans is None else spans)
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return path
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=2)
@@ -467,6 +486,9 @@ def main(argv=None) -> int:
                          "plain liveness sim")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection seed (scenarios only)")
+    ap.add_argument("--dump-trace", default=None, metavar="PATH",
+                    help="after the run, write the stitched cross-node "
+                         "Chrome trace (one pid per node) to PATH")
     args = ap.parse_args(argv)
     if args.scenario:
         from .scenarios import run_scenario, scenario_names
@@ -476,6 +498,9 @@ def main(argv=None) -> int:
             return 0
         result = run_scenario(args.scenario, seed=args.seed)
         print(result.render())
+        if args.dump_trace:
+            print(f"stitched trace -> "
+                  f"{write_stitched_trace(args.dump_trace)}")
         return 0 if result.ok else 1
     spec = minimal_spec(altair_fork_epoch=0)
     net = LocalNetwork(spec, args.nodes, args.validators,
@@ -485,6 +510,8 @@ def main(argv=None) -> int:
         results = net.checks(args.epochs)
     finally:
         net.stop()
+    if args.dump_trace:
+        print(f"stitched trace -> {write_stitched_trace(args.dump_trace)}")
     ok = True
     for r in results:
         print(f"[{'PASS' if r.ok else 'FAIL'}] {r.name}: {r.detail}")

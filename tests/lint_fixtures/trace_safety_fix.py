@@ -123,3 +123,37 @@ def bad_callback_then_sync(x):
                           jax.ShapeDtypeStruct(x.shape, x.dtype), x)
     n = y.item()  # seeded
     return n
+
+
+# -- graftpath causal-scope discipline (ISSUE 13) ----------------------------
+
+def on_deliver_bare(peer, topic, data):
+    # delivery callback (peer param) opening a span with no causal
+    # identity: the cross-node stitcher can never join this trace
+    with span("gossip_deliver"):  # seeded
+        return data
+
+
+def on_deliver_two_bare(peer, data):
+    with span("gossip_deliver"):  # seeded
+        with span("rpc_serve"):  # seeded
+            return data
+
+
+def on_deliver_with_mid(peer, topic, data, mid):
+    # a causal kwarg on the span clears the callback
+    with span("gossip_deliver", message_id=mid):
+        return data
+
+
+def on_serve_with_annotate(peer, req):
+    # annotate() with a causal key clears the whole function
+    with span("rpc_serve"):
+        annotate(req_id="ab12")
+        return req
+
+
+def pump_without_peer(topic, data):
+    # not a delivery callback (no peer param): bare spans are fine
+    with span("gossip_deliver"):
+        return data

@@ -5,11 +5,17 @@
     curl -s $BN/lighthouse/tracing | python tools/trace/report.py -
     python tools/trace/report.py --format json trace.json
     python tools/trace/report.py --since-slot 64 --kind block_pipeline t.json
+    python tools/trace/report.py --critpath trace.json
 
 Accepts the Chrome trace-event document served by /lighthouse/tracing
 (or written by `bench.py --trace`), or the {"data": [span...]} form of
 /lighthouse/tracing/spans.  Prints count / p50 / p95 / max / total per
 stage, widest-total first.
+
+--critpath switches to the graftpath view: the critical path of the
+slowest block trace in the capture (stitched cross-node when the spans
+carry node attrs), with per-stage self-time and the queue-wait vs
+service-time split from obs/critpath.py.
 
 Filters compose:
   --kind K          only stages named K (repeatable)
@@ -30,6 +36,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[2]
 sys.path.insert(0, str(REPO))
 
+from lighthouse_tpu.obs import critpath as critpath_mod  # noqa: E402
 from lighthouse_tpu.obs.report import (  # noqa: E402
     render_table, summarize_chrome, summarize_durations,
 )
@@ -101,6 +108,24 @@ def summarize_any(doc) -> dict:
     return summarize_durations(by_stage)
 
 
+def critpath_report(doc) -> dict | None:
+    """Critical path of the slowest block trace in either document
+    shape; None when the capture is empty."""
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        spans = critpath_mod.spans_from_chrome(doc)
+    else:
+        spans = critpath_mod.spans_from_json(_norm_spans(doc) or [])
+    comp = critpath_mod.worst_component(spans)
+    if comp is None:
+        return None
+    rep = critpath_mod.component_report(comp)
+    if not rep["segments"]:
+        return None
+    rep["nodes"] = comp.node_labels()
+    rep["block_roots"] = comp.block_roots()
+    return rep
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("path", help="trace file, or '-' for stdin")
@@ -114,6 +139,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--since-slot", type=int, default=None, metavar="N",
                     help="only traces whose slot-anchored root is at "
                          "slot >= N")
+    ap.add_argument("--critpath", action="store_true",
+                    help="critical path of the slowest block trace "
+                         "instead of the per-stage table")
     args = ap.parse_args(argv)
     try:
         raw = sys.stdin.read() if args.path == "-" else \
@@ -123,9 +151,19 @@ def main(argv: list[str] | None = None) -> int:
         print(f"unreadable trace input: {e}", file=sys.stderr)
         return 2
     doc = filter_doc(doc, args.kind, args.since_slot)
+    want_json = args.json or args.fmt == "json"
+    if args.critpath:
+        rep = critpath_report(doc)
+        if rep is None:
+            print("no spans in capture", file=sys.stderr)
+            return 2
+        print(json.dumps(rep, indent=2) if want_json
+              else critpath_mod.render_critical_path(
+                  rep, "slowest block trace"))
+        return 0
     summary = summarize_any(doc)
     print(json.dumps(summary, indent=2)
-          if args.json or args.fmt == "json" else render_table(summary))
+          if want_json else render_table(summary))
     return 0
 
 
