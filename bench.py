@@ -21,6 +21,9 @@ Metrics (BASELINE.md north stars):
 - LHTPU_BENCH=serve / --serve: Beacon-API serving-tier req/s on the VC
   hot path (duties + attestation_data) at 1M validators vs the uncached
   unit cost, plus the api_request span p95 (>=10x target; ISSUE 12).
+- LHTPU_BENCH=replay / --replay: graftflow epochs_replayed_per_sec,
+  sequential vs the epoch-pipelined replay engine at 1M validators with
+  per-stage occupancy, bit-exact head asserted (>=2x target; ISSUE 14).
 """
 import json
 import os
@@ -647,6 +650,127 @@ def bench_import_critpath():
     }
 
 
+def bench_replay():
+    """graftflow (ISSUE 14): epochs replayed per second, sequential
+    ``process_chain_segment`` vs the epoch-pipelined ``ReplayEngine``,
+    on twin anchored chains at N validators.  The segment is `epochs`
+    epochs of light blocks — range-sync and backfill replay *history*,
+    which sits far below the gossip worst case ``bench_import_critpath``
+    times — built untimed with real claimed state roots (that pass also
+    primes the shuffle/pubkey caches both timed runs then share).  The
+    pipelined head block root and head state root must be bit-identical
+    to the sequential oracle's before any number is reported."""
+    from lighthouse_tpu.chain.builder import BeaconChainBuilder
+    from lighthouse_tpu.chain.execution import MockExecutionLayer
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.specs.chain_spec import ForkName, mainnet_spec
+    from lighthouse_tpu.ssz import htr
+    from lighthouse_tpu.state_transition import (
+        VerifySignatures, per_block_processing, process_slots,
+    )
+    from lighthouse_tpu.state_transition.helpers import (
+        get_beacon_proposer_index,
+    )
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    n = int(os.environ.get("LHTPU_BENCH_STF_N", N_VALIDATORS))
+    epochs = int(os.environ.get("LHTPU_BENCH_REPLAY_EPOCHS", 2))
+    bls.set_backend("fake")
+    spec = mainnet_spec()
+    spe = spec.preset.slots_per_epoch
+    slot0 = 100_000 * spe            # epoch-aligned anchor
+    state = build_beacon_state(n, slot0)
+    T = state.T
+    sig = b"\x80" + b"\x00" * 95
+    anchor_body = T.BeaconBlockBody[ForkName.ALTAIR](
+        randao_reveal=sig, eth1_data=state.eth1_data,
+        graffiti=b"\x00" * 32)
+    anchor = T.BeaconBlock[ForkName.ALTAIR](
+        slot=slot0 - 1, proposer_index=0, parent_root=b"\x11" * 32,
+        state_root=b"\x22" * 32, body=anchor_body)
+    state.latest_block_header = T.BeaconBlockHeader(
+        slot=slot0 - 1, proposer_index=0, parent_root=b"\x11" * 32,
+        state_root=b"\x22" * 32, body_root=htr(anchor_body))
+    signed_anchor = T.SignedBeaconBlock[ForkName.ALTAIR](
+        message=anchor, signature=sig)
+    anchor_state = state.copy()
+
+    # untimed segment build: one sequential pass computing the claimed
+    # state roots the replayed blocks carry
+    blocks = []
+    work = state
+    parent_root = htr(work.latest_block_header)
+    sync_agg = T.SyncAggregate(
+        sync_committee_bits=[True] * T.preset.sync_committee_size,
+        sync_committee_signature=sig)
+    for i in range(epochs * spe):
+        s = slot0 + 1 + i
+        process_slots(work, s)
+        body = T.BeaconBlockBody[ForkName.ALTAIR](
+            randao_reveal=sig, eth1_data=work.eth1_data,
+            graffiti=b"\x00" * 32)
+        body.sync_aggregate = sync_agg
+        block = T.BeaconBlock[ForkName.ALTAIR](
+            slot=s, proposer_index=get_beacon_proposer_index(work),
+            parent_root=parent_root, state_root=b"\x00" * 32, body=body)
+        sb = T.SignedBeaconBlock[ForkName.ALTAIR](
+            message=block, signature=sig)
+        per_block_processing(work, sb, VerifySignatures.FALSE)
+        block.state_root = work.hash_tree_root()
+        parent_root = htr(block)
+        blocks.append(sb)
+    del work, state
+
+    def _mk_chain():
+        return (BeaconChainBuilder(spec)
+                .weak_subjectivity_anchor(anchor_state.copy(),
+                                          signed_anchor)
+                .slot_clock(ManualSlotClock(
+                    0, spec.seconds_per_slot,
+                    current_slot=slot0 + epochs * spe + 1))
+                .execution_layer(MockExecutionLayer())
+                .build())
+
+    seq_chain = _mk_chain()
+    t0 = time.perf_counter()
+    n_seq = seq_chain.process_chain_segment(list(blocks))
+    t_seq = time.perf_counter() - t0
+
+    pipe_chain = _mk_chain()
+    engine = pipe_chain.replay_engine()
+    t0 = time.perf_counter()
+    n_pipe = engine.replay_segment(list(blocks))
+    t_pipe = time.perf_counter() - t0
+
+    if n_seq != n_pipe:
+        raise RuntimeError(f"import counts diverge: {n_seq} vs {n_pipe}")
+    hs, hp = seq_chain.head(), pipe_chain.head()
+    if hs.head_block_root != hp.head_block_root or \
+            hs.head_state.hash_tree_root() != \
+            hp.head_state.hash_tree_root():
+        raise RuntimeError(
+            "pipelined replay diverged from the sequential oracle")
+    snap = engine.snapshot()
+    last = snap["last_segment"] or {}
+    return {
+        "n_validators": n,
+        "epochs": epochs,
+        "blocks": len(blocks),
+        "sig_backend": "fake",
+        "sequential_s": round(t_seq, 3),
+        "pipelined_s": round(t_pipe, 3),
+        "epochs_replayed_per_sec": {
+            "sequential": round(epochs / t_seq, 3),
+            "pipelined": round(epochs / t_pipe, 3),
+        },
+        "speedup": round(t_seq / t_pipe, 3),
+        "stage_occupancy": last.get("occupancy"),
+        "queue_high_water": snap["queue_high_water"],
+        "sigs_deduped": snap["sigs_deduped"],
+        "head_match": True,
+    }
+
+
 def _measured_host_baseline():
     """Measured single-pairing-check cost on the native C++ backend, scaled
     to the reference's 4-core node.  Returns (sigs_per_sec, source) where
@@ -743,6 +867,21 @@ def child_main():
             "platform": platform,
             "serve": sv,
         }
+    elif mode == "replay":
+        rp = bench_replay()
+        rec = {
+            "metric": "replay_pipeline",
+            "value": rp["epochs_replayed_per_sec"]["pipelined"],
+            "unit": "epochs/s",
+            # acceptance gate: >=2x the sequential import loop at the
+            # same validator count, so >=1.0 here meets it
+            "vs_baseline": round(rp["speedup"] / 2.0, 3),
+            "platform": platform,
+            "replay": rp,
+            "replay_epochs_per_sec_pipelined":
+                rp["epochs_replayed_per_sec"]["pipelined"],
+            "replay_speedup": rp["speedup"],
+        }
     elif mode == "mxu":
         mm = bench_mont_mul_modes()
         rec = {
@@ -788,6 +927,7 @@ GATED_METRICS = [
     ("block_import_ms_1m.signatures_off", "lower", None),
     ("state_copy_ms", "lower", None),
     ("mxu_mode_speedup", "higher", "mxu_platform"),
+    ("replay_epochs_per_sec_pipelined", "higher", None),  # host-side
 ]
 
 
@@ -1049,6 +1189,25 @@ def tpu_probe(timeout=90):
     return out
 
 
+def _replay_record(force_cpu: bool):
+    """One bounded child for the graftflow replay numbers (ISSUE 14).
+    Twin anchored chains plus a sequential oracle pass are pure
+    host/numpy work, so it always runs forced-CPU."""
+    if os.environ.get("LHTPU_BENCH_REPLAY", "1") == "0":
+        return None
+    prev = os.environ.get("LHTPU_BENCH")
+    os.environ["LHTPU_BENCH"] = "replay"
+    try:
+        rec, _ = _try_child(True, int(os.environ.get(
+            "LHTPU_BENCH_REPLAY_TIMEOUT", 1200)))
+        return rec
+    finally:
+        if prev is None:
+            del os.environ["LHTPU_BENCH"]
+        else:
+            os.environ["LHTPU_BENCH"] = prev
+
+
 def _mxu_record(force_cpu: bool):
     """One bounded child for the MXU-mode mont_mul measurement — runs
     LAST so its cold compiles can never cost the flagship records."""
@@ -1078,6 +1237,11 @@ def main():
         # serving-tier req/s (ISSUE 12): host-side workload, so always
         # forced-CPU — a wedged TPU tunnel must never cost this record
         os.environ["LHTPU_BENCH"] = "serve"
+        os.environ["LHTPU_BENCH_FORCE_CPU"] = "1"
+    if "--replay" in sys.argv:
+        # graftflow replay throughput (ISSUE 14): host-side workload,
+        # so always forced-CPU
+        os.environ["LHTPU_BENCH"] = "replay"
         os.environ["LHTPU_BENCH_FORCE_CPU"] = "1"
     if os.environ.get("LHTPU_BENCH_CHILD"):
         return child_main()
@@ -1125,6 +1289,13 @@ def main():
                         stf_rec.get("state_copy_gate_pass")
                     rec["import_critpath_1m"] = \
                         stf_rec.get("import_critpath_1m")
+                replay_rec = _replay_record(force_cpu)
+                if replay_rec is not None and replay_rec.get("value"):
+                    rec["replay_epochs_per_sec_pipelined"] = \
+                        replay_rec["replay_epochs_per_sec_pipelined"]
+                    rec["replay_speedup"] = \
+                        replay_rec.get("replay_speedup")
+                    rec["replay"] = replay_rec.get("replay")
                 mxu_rec = _mxu_record(force_cpu)
                 if mxu_rec is not None and mxu_rec.get("value"):
                     rec["mont_mul_per_sec"] = \
@@ -1141,6 +1312,7 @@ def main():
         "stf": "stf_mainnet_envelope_1m_validators",
         "mxu": "mont_mul_mxu_modes",
         "serve": "api_serving_tier",
+        "replay": "replay_pipeline",
     }.get(os.environ.get("LHTPU_BENCH", "tree_hash"),
           "beacon_state_tree_hash_1m_validators")
     print(json.dumps({

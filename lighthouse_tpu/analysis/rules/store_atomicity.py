@@ -11,7 +11,11 @@ window the batch API closed.
 Scope:
 - ``chain/`` and ``network/sync/`` modules: every direct call to a
   mutator is flagged — these layers must only speak StoreOp batches
-  (``StoreOp.put_block(...)`` constructors are of course exempt);
+  (``StoreOp.put_block(...)`` constructors are of course exempt).  This
+  includes the graftflow replay commit sequences (``chain/replay/``,
+  ISSUE 14), where the per-epoch ``do_atomically`` batch is the single
+  commit point the crashpoint ladder recovers to — a bare per-block put
+  inside a commit stage tears the epoch;
 - ``store/hot_cold.py``: only inside the commit-sequence methods
   (``store_genesis`` / ``migrate_database`` / ``_migrate_database``) —
   the rest of the file IS the implementation of the single-put API and
@@ -85,8 +89,8 @@ class _Scan(ast.NodeVisitor):
 class StoreAtomicityRule(Rule):
     name = "store-atomicity"
     description = ("direct put_block/put_state/_put_meta on import/"
-                   "genesis/migrate/persist paths bypassing the "
-                   "HotColdDB.do_atomically batch API")
+                   "replay-commit/genesis/migrate/persist paths "
+                   "bypassing the HotColdDB.do_atomically batch API")
 
     def summarize_module(self, module: Module, project: Project):
         rel = module.relpath

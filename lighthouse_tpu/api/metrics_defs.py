@@ -232,6 +232,36 @@ CATALOG: dict[str, tuple[str, str]] = {
     "api_request_seconds":
         ("hist", "Serving-tier request latency (api_request span: "
                  "admission + cache/coalesce + backend)"),
+    # -- graftflow replay pipeline (chain/replay/, ISSUE 14) --------------
+    "replay_stage_admission_seconds":
+        ("hist", "Replay admission stage latency (known-block filter, "
+                 "parent check, epoch chunking)"),
+    "replay_stage_signature_seconds":
+        ("hist", "Replay epoch-amortized signature verification latency "
+                 "(one verify_signature_sets per epoch)"),
+    "replay_stage_stf_seconds":
+        ("hist", "Replay per-block state transition latency (deferred "
+                 "merkleization: claimed roots patched, no per-slot "
+                 "hash)"),
+    "replay_stage_merkle_seconds":
+        ("hist", "Replay per-epoch incremental-hasher flush latency"),
+    "replay_stage_commit_seconds":
+        ("hist", "Replay per-epoch atomic commit latency (one StoreOp "
+                 "batch + fork choice + head recompute)"),
+    "replay_sigs_deduped_total":
+        ("counter", "Proposal signature sets skipped during replay "
+                    "because the exact block root already passed the "
+                    "gossip-edge proposer check"),
+    "replay_blocks_committed_total":
+        ("counter", "Blocks committed by the replay pipeline"),
+    "replay_epochs_committed_total":
+        ("counter", "Epoch batches committed by the replay pipeline"),
+    "replay_active":
+        ("gauge", "1 while a replay segment is in flight"),
+    "replay_queue_depth_signature":
+        ("gauge", "Replay signature hand-off queue depth"),
+    "replay_queue_depth_commit":
+        ("gauge", "Replay commit hand-off queue depth"),
     # -- JAX runtime accounting (obs/jax_accounting) ----------------------
     "jax_compile_total":
         ("counter", "XLA programs compiled at runtime (recompile storms "

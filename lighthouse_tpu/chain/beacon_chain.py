@@ -179,6 +179,7 @@ class BeaconChain:
         self.monitor_pubkeys_pending: list[bytes] = []
         self._monitored_epoch = 0
         self.eth1_service = None       # optional Eth1Service
+        self._replay_engine = None     # lazy graftflow pipeline (replay/)
 
         store.store_genesis(self.genesis_block_root, genesis_state,
                             genesis_block)
@@ -537,6 +538,17 @@ class BeaconChain:
                     "light client cache update failed")
         self.recompute_head()
         return block_root
+
+    def replay_engine(self):
+        """graftflow: the epoch-pipelined replay engine for range-sync
+        and backfill segments (chain/replay/, ISSUE 14).  Lazy so
+        store-less rigs never pay for the pipeline; the sequential
+        :meth:`process_chain_segment` below stays as its bit-exact
+        oracle."""
+        if self._replay_engine is None:
+            from .replay import ReplayEngine
+            self._replay_engine = ReplayEngine(self)
+        return self._replay_engine
 
     def process_chain_segment(self, blocks: list) -> int:
         """Range-sync import. Per epoch-aligned chunk: signatures are batched

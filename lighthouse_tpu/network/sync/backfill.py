@@ -140,15 +140,23 @@ class BackfillSync:
                 return
             _, expected_root = anchor
             ok = True
-            stored_here = 0
+            pairs = []
             for sb in reversed(blocks):
                 root = self.ctx.block_root(sb)
                 if root != expected_root:
                     ok = False
                     break
-                self.ctx.store_backfill_block(root, sb)
+                pairs.append((root, sb))
                 expected_root = sb.message.parent_root
-                stored_here += 1
+            # the linked prefix lands as ONE atomic hot batch (graftflow,
+            # ISSUE 14) — per-block stores remain for bare test contexts
+            store_batch = getattr(self.ctx, "store_backfill_batch", None)
+            if store_batch is not None:
+                store_batch(pairs)
+            else:
+                for root, sb in pairs:
+                    self.ctx.store_backfill_block(root, sb)
+            stored_here = len(pairs)
             if not ok:
                 if (stored_here == 0 and self._advanced_by is not None
                         and self._advanced_by[0] != batch.id

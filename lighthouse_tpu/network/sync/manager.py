@@ -174,8 +174,12 @@ class _RealSyncContext:
         return htr(signed_block.message)
 
     def process_segment(self, blocks: list) -> tuple[int, str | None]:
+        # graftflow (chain/replay/, ISSUE 14): epoch-pipelined replay with
+        # batched signatures, deferred merkleization and one atomic store
+        # commit per epoch — the sequential process_chain_segment stays as
+        # its bit-exact oracle
         try:
-            n = self.chain.process_chain_segment(blocks)
+            n = self.chain.replay_engine().replay_segment(blocks)
         except BlockError as e:
             return 0, e.kind
         with self._lock:
@@ -225,6 +229,11 @@ class _RealSyncContext:
         self.chain.store.do_atomically([StoreOp.put_block(root, sb)],
                                        fsync=False)
         self.chain.store.freezer_put_block_root(sb.message.slot, root)
+
+    def store_backfill_batch(self, pairs: list) -> None:
+        # whole validated batch as ONE atomic hot batch + freezer roots
+        # (graftflow backfill commit, same hot-first crash ordering)
+        self.chain.replay_engine().backfill_batch(pairs)
 
     # -- request IO ----------------------------------------------------------
 

@@ -89,6 +89,14 @@ _COSIGNALS = [
      "sync peers quarantined"),
     ("api_requests_total", "delta", "serving-tier requests served"),
     ("api_shed_total", "delta", "serving-tier requests shed"),
+    ("replay_blocks_committed_total", "delta",
+     "replay blocks committed"),
+    ("replay_sigs_deduped_total", "delta",
+     "replay proposal signatures deduped"),
+    ("replay_queue_depth_signature", "level",
+     "replay signature queue depth"),
+    ("replay_queue_depth_commit", "level",
+     "replay commit queue depth"),
 ]
 
 
@@ -149,6 +157,7 @@ def diagnose(doc: dict) -> dict:
         "processors": doc.get("processors") or [],
         "sync": doc.get("sync"),
         "serving": doc.get("serving"),
+        "replay": doc.get("replay"),
         "critpath": doc.get("critpath"),
         "recovery": doc.get("recovery"),
         "incidents": [_correlate_incident(i, slots, series)
@@ -237,6 +246,33 @@ def render(diag: dict) -> str:
             lines.append(
                 f"    slowest: {sl.get('endpoint')} "
                 f"{_fmt_num(sl.get('worst_ms'))} ms worst")
+    # replay sections are post-ISSUE-14 dumps only; older dumps lack
+    # the key and render nothing (same contract as sync above)
+    for rp in diag.get("replay") or []:
+        if not isinstance(rp, dict):
+            continue
+        if "error" in rp:
+            lines.append(f"  replay: <{rp['error']}>")
+            continue
+        last = rp.get("last_segment") or {}
+        lines.append(
+            f"  replay: {'ACTIVE' if rp.get('active') else 'idle'}, "
+            f"commit seq {_fmt_num(rp.get('commit_seq'))}, "
+            f"{_fmt_num(rp.get('blocks_committed'))} blocks committed "
+            f"over {_fmt_num(rp.get('segments_replayed'))} segment(s), "
+            f"{_fmt_num(rp.get('sigs_deduped'))} sigs deduped, "
+            f"queue high water "
+            f"{_fmt_num((rp.get('queue_high_water') or {}).get('signature'))}"
+            f"/"
+            f"{_fmt_num((rp.get('queue_high_water') or {}).get('commit'))}")
+        occ = last.get("occupancy") or {}
+        if occ:
+            occ_s = " ".join(f"{k}={occ[k]:.2f}" for k in sorted(occ))
+            lines.append(
+                f"    last segment: {_fmt_num(last.get('blocks'))} blocks "
+                f"/ {_fmt_num(last.get('epochs'))} epochs at "
+                f"{last.get('epochs_per_sec', 0.0):.2f} epochs/s — "
+                f"occupancy {occ_s}")
     # critpath sections are post-ISSUE-13 dumps only; older dumps lack
     # the key and render nothing (same contract as sync above)
     cp = diag.get("critpath")

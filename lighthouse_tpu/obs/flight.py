@@ -19,6 +19,8 @@ offline:
   backoff/quarantine, recent download-validation rejects)
 - a serving-tier summary per registered API tier (queue depth, cache
   hit ratio, shed counts, slowest endpoints — ISSUE 12)
+- a graftflow replay summary per registered engine (stage queue
+  depths, epoch commit seq, per-stage occupancy — ISSUE 14)
 - the trace-stamped ``log_buffer`` tail
 - every incident (open and resolved) plus current SLO status
 - the last store-recovery report (``chain.persistence.LAST_RECOVERY``),
@@ -138,6 +140,16 @@ def _serving_summary(tier) -> dict:
         return {"error": repr(exc)}
 
 
+def _replay_summary(engine) -> dict:
+    """graftflow engine snapshot: stage queue depths / high-water,
+    per-stage busy seconds, epoch commit sequence, last-segment
+    occupancy (ISSUE 14)."""
+    try:
+        return engine.snapshot()
+    except Exception as exc:
+        return {"error": repr(exc)}
+
+
 def _processor_summary(proc) -> dict:
     out: dict = {}
     try:
@@ -194,6 +206,8 @@ class FlightRecorder:
             doc["sync"] = sync or None
             serving = [_serving_summary(t) for t in w.servings()]
             doc["serving"] = serving or None
+            replay = [_replay_summary(e) for e in w.replays()]
+            doc["replay"] = replay or None
         else:
             doc["incidents"] = []
             doc["slo"] = {}
@@ -201,6 +215,7 @@ class FlightRecorder:
             doc["processors"] = []
             doc["sync"] = None
             doc["serving"] = None
+            doc["replay"] = None
         doc["recovery"] = _recovery_report()
         doc["log_tail"] = global_log_buffer().tail(LOG_TAIL)
         return _json_safe(doc)

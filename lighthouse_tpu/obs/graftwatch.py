@@ -33,6 +33,7 @@ class Graftwatch:
         self._chains: list = []          # weakrefs
         self._processors: list = []      # weakrefs
         self._servings: list = []        # weakrefs (api serving tiers)
+        self._replays: list = []         # weakrefs (graftflow engines)
         self._lock = threading.Lock()
         self._last_slot: int | None = None
         self.auto_dump = False
@@ -59,6 +60,13 @@ class Graftwatch:
             if not any(r() is tier for r in self._servings):
                 self._servings.append(weakref.ref(tier))
 
+    def register_replay(self, engine) -> None:
+        with self._lock:
+            self._replays = [r for r in self._replays
+                             if r() is not None]
+            if not any(r() is engine for r in self._replays):
+                self._replays.append(weakref.ref(engine))
+
     def chains(self) -> list:
         with self._lock:
             return [c for c in (r() for r in self._chains)
@@ -73,6 +81,11 @@ class Graftwatch:
         with self._lock:
             return [s for s in (r() for r in self._servings)
                     if s is not None]
+
+    def replays(self) -> list:
+        with self._lock:
+            return [e for e in (r() for r in self._replays)
+                    if e is not None]
 
     # -- configuration ---------------------------------------------------
 
@@ -146,3 +159,7 @@ def register_processor(proc) -> None:
 
 def register_serving(tier) -> None:
     get().register_serving(tier)
+
+
+def register_replay(engine) -> None:
+    get().register_replay(engine)

@@ -43,6 +43,10 @@ hand:
 ``propagation_p95``         publish -> import block propagation across
                             the in-process fleet (graftpath's stitched
                             lens on gossip health, ISSUE 13)
+``replay_throughput``       while graftflow is replaying a segment the
+                            pipeline commits >= 1 block per
+                            slot-equivalent — a stalled stage surfaces
+                            instead of wedging sync (ISSUE 14)
 ==========================  ============================================
 """
 from __future__ import annotations
@@ -198,6 +202,35 @@ def _check_sync_progress(floor_blocks: float, stall_slots: int) -> Check:
     return check
 
 
+def _check_replay_throughput(floor_blocks: float,
+                             stall_slots: int) -> Check:
+    """Breach after `stall_slots` CONSECUTIVE slots with a replay
+    segment in flight (``replay_active`` gauge) committing fewer than
+    `floor_blocks` blocks — the 1 block/slot-equivalent floor a
+    replaying node must sustain to ever catch up (ISSUE 14).  Single
+    slow slots are normal (an epoch batch commits in bursts); a run of
+    them means a pipeline stage stalled."""
+    stalled = {"n": 0}      # closure state: consecutive stalled slots
+
+    def check(ctx: EvalContext):
+        active = ctx.sampler.latest("replay_active")
+        if active is None or active == 0:
+            stalled["n"] = 0
+            return None, False, "no replay in flight"
+        delta = ctx.sampler.latest("replay_blocks_committed_total")
+        delta = 0.0 if delta is None else delta
+        if delta >= floor_blocks:
+            stalled["n"] = 0
+            return delta, False, \
+                f"{delta:.0f} blocks committed this slot"
+        stalled["n"] += 1
+        return delta, stalled["n"] >= stall_slots, (
+            f"replay active but {delta:.0f} blocks committed this slot "
+            f"({stalled['n']} consecutive below floor "
+            f"{floor_blocks:.0f})")
+    return check
+
+
 def _check_propagation_p95(budget_s: float) -> Check:
     def check(ctx: EvalContext):
         p95 = ctx.sampler.latest("block_propagation_seconds.p95")
@@ -243,6 +276,8 @@ def default_slos(pipeline_p95_s: float = 5.0,
                  serving_p95_s: float = 0.5,
                  serving_shed_ratio: float = 0.5,
                  serving_min_requests: int = 8,
+                 replay_floor_blocks: float = 1.0,
+                 replay_stall_slots: int = 3,
                  # propagation subsumes the whole verify->import pipeline,
                  # so its budget tracks pipeline_p95_s, not gossip alone
                  propagation_p95_s: float = 5.0) -> list[SLO]:
@@ -290,6 +325,14 @@ def default_slos(pipeline_p95_s: float = 5.0,
             "budgeted fraction of requests per slot",
             _check_serving_shed_rate(serving_shed_ratio,
                                      serving_min_requests)),
+        SLO("replay_throughput", "replay_blocks_committed_total",
+            replay_floor_blocks,
+            "while a graftflow replay segment is in flight the pipeline "
+            "commits at least 1 block per slot-equivalent; a stalled "
+            "stage must surface, not silently wedge sync (ISSUE 14)",
+            _check_replay_throughput(replay_floor_blocks,
+                                     replay_stall_slots),
+            resolve_after=2),
         SLO("propagation_p95", "block_propagation_seconds",
             propagation_p95_s,
             "publish -> import block propagation p95 across the fleet "

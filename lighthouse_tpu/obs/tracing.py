@@ -69,6 +69,12 @@ SPAN_KINDS: dict[str, str] = {
     "stf_block": "stf_block_seconds",
     # Beacon-API serving tier (api/serving/tier.py, ISSUE 12)
     "api_request": "api_request_seconds",
+    # graftflow replay pipeline stages (chain/replay/, ISSUE 14)
+    "replay_admission": "replay_stage_admission_seconds",
+    "replay_signature": "replay_stage_signature_seconds",
+    "replay_stf": "replay_stage_stf_seconds",
+    "replay_merkle": "replay_stage_merkle_seconds",
+    "replay_commit": "replay_stage_commit_seconds",
     # graftpath cross-node causal annotation points (obs/causal.py)
     "gossip_publish": "gossipsub_publish_seconds",
     "gossip_deliver": "gossipsub_deliver_seconds",

@@ -22,7 +22,7 @@ from ..specs.constants import (
     ETH1_ADDRESS_WITHDRAWAL_PREFIX, FAR_FUTURE_EPOCH, GENESIS_EPOCH,
     PROPOSER_WEIGHT, WEIGHT_DENOMINATOR,
 )
-from .shuffle import compute_shuffled_indices
+from .shuffle import compute_shuffled_index_batch, compute_shuffled_indices
 
 
 class StateError(Exception):
@@ -297,7 +297,11 @@ def compute_proposer_index(state: BeaconState, indices: np.ndarray,
         raise StateError("no active validators")
     p = state.T.preset
     n = len(indices)
-    sigma = compute_shuffled_indices(n, seed, p.shuffle_round_count)
+    # the seed folds in the slot, so this shuffle is queried once and
+    # thrown away: above a few batches' worth of indices, evaluating
+    # sigma only at the sampled positions beats shuffling the whole set
+    sigma = (None if n > 8 * _SAMPLE_BATCH
+             else compute_shuffled_indices(n, seed, p.shuffle_round_count))
     eb = state.validators.effective_balance
     electra = state.fork_name >= ForkName.ELECTRA
     max_eb = (p.max_effective_balance_electra if electra
@@ -306,7 +310,11 @@ def compute_proposer_index(state: BeaconState, indices: np.ndarray,
     offsets = np.arange(_SAMPLE_BATCH)
     i0 = 0
     while True:
-        candidates = indices[sigma[(i0 + offsets) % n]]
+        pos = (i0 + offsets) % n
+        src = (compute_shuffled_index_batch(pos, n, seed,
+                                            p.shuffle_round_count)
+               if sigma is None else sigma[pos])
+        candidates = indices[src]
         r = _candidate_randomness(seed, i0, _SAMPLE_BATCH, electra)
         ok = np.flatnonzero(
             eb[candidates].astype(np.int64) * scale >= max_eb * r)

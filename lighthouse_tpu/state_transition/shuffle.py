@@ -78,6 +78,45 @@ def compute_shuffled_indices(n: int, seed: bytes,
     return idx
 
 
+def compute_shuffled_index_batch(positions: np.ndarray, n: int, seed: bytes,
+                                 rounds: int) -> np.ndarray:
+    """``sigma[positions]`` without materializing the whole permutation.
+
+    The proposer seed folds in the slot, so every block queries a fresh
+    shuffle — but rejection sampling only ever looks at a handful of
+    candidate positions, and shuffling all n indices (90 numpy passes
+    over the full vector at 1M validators) to read a few of them is the
+    dominant per-block state-transition cost.  This runs the scalar spec
+    transform over just the queried positions, with each round's source
+    digests deduped per 256-index block and batched through the native
+    hasher.
+    """
+    if len(positions) == 0:
+        return np.zeros(0, dtype=np.int64)
+    from ..utils.native_hash import hash_short_batch
+    idx = np.asarray(positions, dtype=np.int64).copy()
+    for r in range(rounds):
+        pivot = _round_pivot(seed, r, n)
+        flip = (pivot - idx) % n
+        pos = np.maximum(idx, flip)
+        blocks = np.unique(pos // 256)
+        msgs = np.empty((len(blocks), 37), np.uint8)
+        msgs[:, :32] = np.frombuffer(seed, np.uint8)
+        msgs[:, 32] = r
+        msgs[:, 33:] = blocks.astype("<u4").view(np.uint8).reshape(-1, 4)
+        raw = hash_short_batch(msgs.tobytes(), 37)
+        if raw is None:
+            raw = b"".join(
+                hashlib.sha256(
+                    seed + bytes([r]) + int(b).to_bytes(4, "little")
+                ).digest() for b in blocks)
+        digests = np.frombuffer(raw, np.uint8).reshape(len(blocks), 32)
+        bits = np.unpackbits(digests, axis=1, bitorder="little")
+        bit = bits[np.searchsorted(blocks, pos // 256), pos % 256]
+        idx = np.where(bit == 1, flip, idx)
+    return idx
+
+
 def compute_shuffled_index(index: int, n: int, seed: bytes,
                            rounds: int) -> int:
     """Spec-exact scalar compute_shuffled_index (forward)."""

@@ -63,14 +63,21 @@ class StoreOp:
     kind: str
     key: bytes = b""
     obj: object = None
+    latest_block_root: bytes | None = None
 
     @classmethod
     def put_block(cls, block_root: bytes, signed_block) -> "StoreOp":
         return cls("put_block", block_root, signed_block)
 
     @classmethod
-    def put_state(cls, state_root: bytes, state) -> "StoreOp":
-        return cls("put_state", state_root, state)
+    def put_state(cls, state_root: bytes, state,
+                  latest_block_root: bytes | None = None) -> "StoreOp":
+        """`latest_block_root` lets callers that already know the root of
+        ``state.latest_block_header`` (with its state_root filled) skip the
+        hash_tree_root the summary would otherwise force — block import
+        knows it: it IS the block's root when ``state`` is a post-block
+        state at the block's own slot."""
+        return cls("put_state", state_root, state, latest_block_root)
 
     @classmethod
     def put_blobs(cls, block_root: bytes, blobs: list) -> "StoreOp":
@@ -184,13 +191,15 @@ class HotColdDB:
             type(signed_block).ssz_type, signed_block)
         return [("put", BLOCK + block_root, data)]
 
-    def _state_kv_ops(self, state_root: bytes, state: BeaconState) -> list:
+    def _state_kv_ops(self, state_root: bytes, state: BeaconState,
+                      latest_block_root: bytes | None = None) -> list:
         p = self.T.preset
         ops = []
         if state.slot % p.slots_per_epoch == 0:
             data = bytes([state.fork_name.value]) + state.serialize()
             ops.append(("put", HOT_STATE_FULL + state_root, data))
-        latest_block_root = self._latest_block_root(state)
+        if latest_block_root is None:
+            latest_block_root = self._latest_block_root(state)
         boundary_slot = (state.slot // p.slots_per_epoch) * p.slots_per_epoch
         boundary_root = (state_root if state.slot == boundary_slot
                          else state.state_roots[
@@ -211,7 +220,7 @@ class HotColdDB:
         if op.kind == "put_block":
             return self._block_kv_ops(op.key, op.obj)
         if op.kind == "put_state":
-            return self._state_kv_ops(op.key, op.obj)
+            return self._state_kv_ops(op.key, op.obj, op.latest_block_root)
         if op.kind == "put_blobs":
             return self._blobs_kv_ops(op.key, op.obj)
         if op.kind == "delete_block":

@@ -73,7 +73,8 @@ _REGISTRY: dict[str, object] = {}
 SLOW_SCENARIOS = frozenset({"long_nonfinality",
                             "checkpoint_sync_partition",
                             "sync_byzantine_pool",
-                            "backfill_under_stall"})
+                            "backfill_under_stall",
+                            "checkpoint_backfill_replay"})
 
 
 def scenario(name: str):
@@ -987,6 +988,114 @@ def scenario_lying_status_chain(seed: int = 0) -> ScenarioResult:
              sp["open_incident"] is None,
              f"sync_progress SLO clean ({sp['last_detail']})")
         _envelope_checks(result, net, trace)
+    finally:
+        net.stop()
+    return result
+
+
+# -- 9. checkpoint sync + graftflow replay catch-up ---------------------------
+
+@scenario("checkpoint_backfill_replay")
+def scenario_checkpoint_backfill_replay(seed: int = 0) -> ScenarioResult:
+    """A checkpoint-synced node catches up to the live head through
+    range sync — which now routes every segment through graftflow's
+    epoch-pipelined replay engine — and then backfills its pre-anchor
+    history through the engine's atomic batch path (ISSUE 14).  The
+    pipelined path must actually run (epoch commits observable on the
+    replay counters and the engine snapshot), converge bit-exactly on
+    the network head, complete the pre-anchor history, and end with the
+    ``replay_throughput`` SLO clean — a wedged pipeline stage must
+    surface as an incident, not as silent non-progress."""
+    result = ScenarioResult("checkpoint_backfill_replay", seed)
+    spec = minimal_spec(altair_fork_epoch=0)
+    spe = spec.preset.slots_per_epoch
+    injector = FaultInjector(seed)
+    net = LocalNetwork(spec, 3, 48, topology="mesh", injector=injector)
+    watch = graftwatch.get()
+    blocks0 = counter_value("replay_blocks_committed_total")
+    epochs0 = counter_value("replay_epochs_committed_total")
+    try:
+        net.run_slots(4 * spe)               # finality for the anchor
+        fin0 = net.nodes[0].harness.chain.finalized_checkpoint()[0]
+        _chk(result, "anchor_finalized", fin0 >= 2,
+             f"anchor node finalized epoch {fin0}")
+        i3 = net.add_node(anchor_from=0, dial=[])
+        node3 = net.nodes[i3]
+        sync3 = node3.network.sync
+        chain3 = node3.harness.chain
+        nid = [net.nodes[j].network.transport.node_id for j in range(3)]
+        for j in range(3):
+            node3.network.dial("127.0.0.1", net.nodes[j].network.port)
+        _wait_statuses(node3, nid)
+        anchor_start = chain3.store.backfill_anchor()
+        with scenario_capture() as trace:
+            # phase A: range-sync forward from the anchor to the head —
+            # every accepted segment replays through the graftflow
+            # pipeline behind process_segment
+            target = net.nodes[0].harness.chain.head().head_block_root
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline:
+                sync3.maybe_sync()
+                if chain3.head().head_block_root == target:
+                    break
+                time.sleep(0.05)
+            # phase B: walk the pre-anchor history to genesis through
+            # the engine's one-atomic-batch-per-response backfill path
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline:
+                sync3.backfill(batch_slots=spe)
+                anchor = chain3.store.backfill_anchor()
+                if anchor is None or anchor[0] == 0:
+                    break
+                time.sleep(0.05)
+            net.run_slots(spe)               # envelope traffic
+        result.trace = trace
+        _chk(result, "caught_up_to_live_head",
+             chain3.head().head_block_root ==
+             net.nodes[0].harness.chain.head().head_block_root,
+             f"synced node head at slot {chain3.head().head_state.slot} "
+             "matches the network's")
+        engine = chain3.replay_engine()
+        snap = engine.snapshot()
+        replayed = counter_value("replay_blocks_committed_total") - blocks0
+        epochs = counter_value("replay_epochs_committed_total") - epochs0
+        _chk(result, "segments_replayed_through_graftflow",
+             snap["commit_seq"] >= 1 and replayed > 0,
+             f"{replayed:.0f} blocks in {epochs:.0f} epoch commits "
+             f"through the pipeline (engine commit_seq "
+             f"{snap['commit_seq']})")
+        last = snap["last_segment"]
+        _chk(result, "stage_occupancy_observed",
+             last is not None and set(last["occupancy"]) ==
+             {"admission", "signature", "stf", "merkle", "commit"},
+             "engine snapshot carries per-stage occupancy for the "
+             "flight recorder")
+        anchor = chain3.store.backfill_anchor()
+        _chk(result, "backfill_complete",
+             anchor is None or anchor[0] == 0,
+             f"backfill anchor {anchor} (started at {anchor_start})")
+        _chk(result, "backfill_batches_atomic",
+             snap["backfill_batches"] >= 1,
+             f"{snap['backfill_batches']} atomic backfill batches")
+        checked = missing = 0
+        for blk in _chain_blocks(net.nodes[0].harness.chain):
+            if (anchor_start is not None
+                    and blk.message.slot < anchor_start[0]):
+                checked += 1
+                if chain3.store.get_block(htr(blk.message)) is None:
+                    missing += 1
+        _chk(result, "history_complete", checked > 0 and missing == 0,
+             f"{checked} pre-anchor canonical blocks checked, "
+             f"{missing} missing")
+        rt = watch.engine.status()["replay_throughput"]
+        rt_incs = watch.engine.incidents_for("replay_throughput")
+        _chk(result, "slo_replay_throughput_clean",
+             rt["open_incident"] is None
+             and all(not i.open for i in rt_incs),
+             f"replay_throughput SLO open_incident="
+             f"{rt['open_incident']}, {len(rt_incs)} incident(s) all "
+             "resolved")
+        _envelope_checks(result, net, trace, max_head_lag=2)
     finally:
         net.stop()
     return result

@@ -44,6 +44,12 @@ REGISTRY: dict[str, str] = {
         "item still at seq N-1",
     "persist:between_head_and_op_pool":
         "persist_chain: head committed, op-pool snapshot still stale",
+    "replay:before_epoch_commit":
+        "graftflow commit stage: fork choice updated in memory, the "
+        "epoch's block+state batch not yet committed",
+    "replay:after_epoch_commit":
+        "graftflow commit stage: epoch batch committed, head recompute "
+        "and chain persist not yet run",
     "migrate:mid_freeze":
         "migrate_database: freezer batch committed, hot prune + split "
         "advance not yet committed",

@@ -36,6 +36,30 @@ class ImportPipeline:
              StoreOp.put_state(signed_block.state_root, state)])
 
 
+class ReplayCommitStage:
+    """graftflow-shaped commit stage (chain/replay/): the epoch batch is
+    the ONLY legal commit point — per-block puts inside the stage tear
+    the epoch's crash atomicity (ISSUE 14)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def commit_epoch_torn(self, staged):
+        # a crash mid-loop leaves a prefix of the epoch's blocks with no
+        # epoch boundary to recover to
+        for signed_block, root, post in staged:
+            self.store.put_block(root, signed_block)  # seeded
+            self.store.put_state(signed_block.state_root, post)  # seeded
+
+    def commit_epoch(self, staged):
+        # the sanctioned shape: the whole epoch lands as ONE batch
+        ops = []
+        for signed_block, root, post in staged:
+            ops.append(StoreOp.put_block(root, signed_block))
+            ops.append(StoreOp.put_state(signed_block.state_root, post))
+        self.store.do_atomically(ops, fsync=False)
+
+
 def backfill(store, root, sb):
     store.put_block(root, sb)  # seeded
     store.freezer_put_block_root(sb.slot, root)

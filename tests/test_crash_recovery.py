@@ -48,8 +48,21 @@ spec = minimal_spec()
 db = os.environ["LHTPU_TEST_DB"]
 store = HotColdDB(NativeKvStore(os.path.join(db, "hot.db")),
                   NativeKvStore(os.path.join(db, "cold.db")), spec)
+spe = spec.preset.slots_per_epoch
 h = BeaconChainHarness(spec, 64, store=store)
-h.extend_chain(4 * spec.preset.slots_per_epoch)
+if os.environ.get("LHTPU_CRASHPOINT", "").startswith("replay:"):
+    # the replay sites live on graftflow's commit stage: gossip-import
+    # the first two epochs, then replay the next two as one segment from
+    # a deterministic in-memory twin, so the armed commit crashpoint
+    # fires mid-segment (hit=2 lands between the two epoch batches)
+    h.extend_chain(2 * spe)
+    twin = BeaconChainHarness(spec, 64)
+    roots = twin.extend_chain(4 * spe)
+    seg = [twin.chain.store.get_block(r) for r in roots[2 * spe:]]
+    h.set_slot(4 * spe + 1)
+    h.chain.replay_engine().replay_segment(seg)
+else:
+    h.extend_chain(4 * spe)
 h.chain.persist()
 print("COMPLETED", h.chain.head().head_block_root.hex())
 """
@@ -57,7 +70,9 @@ print("COMPLETED", h.chain.head().head_block_root.hex())
 #: later hits for the import sites so the crash lands mid-chain, with
 #: real history on both sides of the tear
 SITE_HITS = {"block_import:before_batch": 10,
-             "block_import:after_state_write": 10}
+             "block_import:after_state_write": 10,
+             "replay:before_epoch_commit": 2,
+             "replay:after_epoch_commit": 2}
 
 
 @pytest.fixture(autouse=True)

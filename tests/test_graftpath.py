@@ -288,6 +288,9 @@ class _StubWatch:
     def servings(self):
         return []
 
+    def replays(self):
+        return []
+
 
 def test_flight_dump_carries_worst_trace_critpath(tmp_path):
     import time as _time

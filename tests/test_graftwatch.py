@@ -246,6 +246,9 @@ class _StubWatch:
     def servings(self):
         return []
 
+    def replays(self):
+        return []
+
 
 def _storm_watch():
     """16 deterministic slots with a slot-8..11 storm (same shape as the

@@ -348,6 +348,45 @@ def test_pending_deposits_vectorized_matches_scalar():
             f"trial {trial}: vectorized != scalar"
 
 
+def test_sparse_shuffle_matches_full_permutation():
+    """compute_shuffled_index_batch(pos) == compute_shuffled_indices()[pos]
+    for every size class (single element, partial block, multi-block) —
+    the proposer path swaps between them on validator-set size."""
+    from lighthouse_tpu.state_transition.shuffle import (
+        compute_shuffled_index, compute_shuffled_index_batch,
+        compute_shuffled_indices)
+    rng = np.random.default_rng(7)
+    for n in (1, 2, 255, 256, 257, 5000, 40_000):
+        seed = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        sigma = compute_shuffled_indices(n, seed, 90)
+        pos = rng.integers(0, n, size=min(n, 257))
+        got = compute_shuffled_index_batch(pos, n, seed, 90)
+        assert np.array_equal(sigma[pos], got), n
+        # and both agree with the scalar spec transform
+        for p in pos[:3]:
+            assert compute_shuffled_index(int(p), n, seed, 90) == sigma[p]
+
+
+def test_proposer_index_sparse_path_matches_dense(monkeypatch):
+    """compute_proposer_index through the sparse (no full permutation)
+    path returns the same proposer as the dense path: lower the batch
+    size so a small harness state crosses the n > 8*batch threshold."""
+    import lighthouse_tpu.state_transition.helpers as helpers
+    h = StateHarness(minimal_spec(), 300)
+    state = h.state
+    # perturb effective balances so rejection sampling actually rejects
+    rng = np.random.default_rng(11)
+    idx = rng.integers(0, 300, size=150)
+    state.validators.effective_balance[idx] = 16 * 10**9
+    dense = [get_beacon_proposer_index(state, state.slot + s)
+             for s in range(8)]
+    monkeypatch.setattr(helpers, "_SAMPLE_BATCH", 32)
+    state._proposer_cache = {}
+    sparse = [get_beacon_proposer_index(state, state.slot + s)
+              for s in range(8)]
+    assert dense == sparse
+
+
 @pytest.mark.slow
 def test_epoch_processing_64k_smoke():
     """64k-validator mainnet-preset epoch: the vectorized envelope paths
