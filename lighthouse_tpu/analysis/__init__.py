@@ -7,8 +7,11 @@ kernels, lock/thread discipline for the beacon/network machinery, and
 drift-freedom for spec constants and SSZ schemas.
 
 Entry points:
-- ``python tools/lint/run.py`` — the CLI (text/JSON reports, baseline).
+- ``python tools/lint/run.py`` — the CLI (text/JSON reports, baseline;
+  ``--shared-state`` dumps the graftrace concurrency model for triage).
 - :func:`lighthouse_tpu.analysis.engine.run_project` — library API.
+- ``pytest --sanitize-locks`` — arms :mod:`.locksan`, the runtime lock
+  sanitizer built from the same shared-state model (ISSUE 16).
 
 Rules live in :mod:`lighthouse_tpu.analysis.rules`; each is documented in
 ANALYSIS.md. The suite is pure-AST (no jax import) so it runs in seconds

@@ -2,6 +2,7 @@
 from . import (  # noqa: F401
     compile_budget,
     cow_discipline,
+    data_race,
     device_transfer,
     lock_discipline,
     lock_order,

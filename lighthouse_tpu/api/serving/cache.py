@@ -62,7 +62,13 @@ class ResponseCache:
 
     def on_head_change(self, new_head_root: bytes) -> int:
         """Drop every entry built under a different head. Returns the
-        number of entries invalidated."""
+        number of entries invalidated.
+
+        Runs on the fork-choice event thread while serving workers hit
+        get/put concurrently; the whole scan-and-prune holds ``_lock``,
+        which graftrace pins ('guarded' on hits/misses/invalidated, and
+        the test_graftrace.py satellite keeps this file race-clean —
+        PR 16 audit, no fix needed)."""
         with self._lock:
             stale = [k for k in self._entries if k[2] != new_head_root]
             for k in stale:

@@ -349,10 +349,12 @@ class BeaconChain:
     @property
     def block_times_cache(self):
         if self._block_times_cache is None:
-            from .block_times_cache import BlockTimesCache
-            self._block_times_cache = BlockTimesCache(
-                int(self.genesis_state.genesis_time),
-                self.spec.seconds_per_slot)
+            with self._lock:                # double-checked lazy init
+                if self._block_times_cache is None:
+                    from .block_times_cache import BlockTimesCache
+                    self._block_times_cache = BlockTimesCache(
+                        int(self.genesis_state.genesis_time),
+                        self.spec.seconds_per_slot)
         return self._block_times_cache
 
     def process_blob_sidecar(self, sidecar) -> bytes | None:
@@ -546,8 +548,12 @@ class BeaconChain:
         :meth:`process_chain_segment` below stays as its bit-exact
         oracle."""
         if self._replay_engine is None:
-            from .replay import ReplayEngine
-            self._replay_engine = ReplayEngine(self)
+            # double-checked: the ctor registers with graftwatch, so a
+            # losing duplicate would leak a dead registration
+            with self._lock:
+                if self._replay_engine is None:
+                    from .replay import ReplayEngine
+                    self._replay_engine = ReplayEngine(self)
         return self._replay_engine
 
     def process_chain_segment(self, blocks: list) -> int:
@@ -765,6 +771,13 @@ class BeaconChain:
 
     # -- per-slot tasks ------------------------------------------------------
 
+    def watch_validator_pubkey(self, pk: bytes) -> None:
+        """Queue a --validator-monitor pubkey that is not in the registry
+        yet; per_slot_task re-resolves the list each slot. Locked: the
+        slot timer drains the list concurrently with callers."""
+        with self._lock:
+            self.monitor_pubkeys_pending.append(pk)
+
     def per_slot_task(self) -> None:
         """timer/src/lib.rs tick + state_advance_timer: advance fork choice
         time and pre-advance the head state across the epoch boundary."""
@@ -776,16 +789,24 @@ class BeaconChain:
         # the work; the facade dedupes the rest)
         from ..obs import graftwatch
         graftwatch.on_slot(slot)
-        if self.monitor_pubkeys_pending:
+        with self._lock:
+            pending = self.monitor_pubkeys_pending
+            self.monitor_pubkeys_pending = []
+        if pending:
             registry = self.head().head_state.validators
             still = []
-            for pk in self.monitor_pubkeys_pending:
+            for pk in pending:
                 idx = registry.index_of(pk)
                 if idx is not None:
                     self.validator_monitor.register_validator(idx)
                 else:
                     still.append(pk)
-            self.monitor_pubkeys_pending = still
+            if still:
+                with self._lock:
+                    # keep anything watch_validator_pubkey added while
+                    # we were resolving against the registry
+                    self.monitor_pubkeys_pending = \
+                        still + self.monitor_pubkeys_pending
         from .hot_caches import state_advance
         try:
             state_advance(self, slot)

@@ -169,7 +169,7 @@ class ClientBuilder:
                 self.env.log.info(
                     "validator-monitor pubkey %s not yet in registry; "
                     "will watch for it", "0x" + pk.hex()[:16])
-                client.chain.monitor_pubkeys_pending.append(pk)
+                client.chain.watch_validator_pubkey(pk)
 
         # slasher
         if cfg.slasher_enabled:

@@ -29,6 +29,11 @@ class Environment:
             format="%(asctime)s %(levelname)-5s %(name)s: %(message)s")
         self.log = logging.getLogger("lighthouse_tpu")
         self._shutdown = threading.Event()
+        # guards shutdown_reason: shutdown() is called from any dying
+        # task thread, and the FIRST reason must win (a second task
+        # failing while SIGTERM lands must not overwrite the cause the
+        # operator sees) — graftrace data-race fix
+        self._lock = threading.Lock()
         self.shutdown_reason: str | None = None
         self._tasks: list[threading.Thread] = []
 
@@ -48,7 +53,9 @@ class Environment:
         return t
 
     def shutdown(self, reason: str) -> None:
-        self.shutdown_reason = reason
+        with self._lock:
+            if self.shutdown_reason is None:
+                self.shutdown_reason = reason
         self._shutdown.set()
 
     def shutdown_requested(self) -> bool:
@@ -63,4 +70,5 @@ class Environment:
         except ValueError:
             pass  # not main thread
         self._shutdown.wait()
-        return self.shutdown_reason or "unknown"
+        with self._lock:
+            return self.shutdown_reason or "unknown"

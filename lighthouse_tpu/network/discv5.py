@@ -205,13 +205,20 @@ class Discv5:
 
     def start(self) -> None:
         self._running = True
-        self._thread = threading.Thread(target=self._recv_loop, daemon=True)
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:    # idempotent: one pump only
+                return
+            self._thread = threading.Thread(target=self._recv_loop,
+                                            daemon=True)
+            self._thread.start()
 
     def stop(self) -> None:
         self._running = False
-        if self._thread:
-            self._thread.join(timeout=2)
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+        if thread is not None:
+            thread.join(timeout=2)
         self._threads.join_all(timeout=2)
         self.sock.close()
 

@@ -152,9 +152,11 @@ class GossipEngine:
 
     def start_heartbeat(self) -> None:
         if self._hb_thread is None:
-            self._hb_thread = threading.Thread(target=self._hb_loop,
-                                               daemon=True)
-            self._hb_thread.start()
+            with self._lock:                # double-checked: one loop only
+                if self._hb_thread is None:
+                    self._hb_thread = threading.Thread(target=self._hb_loop,
+                                                       daemon=True)
+                    self._hb_thread.start()
 
     def stop(self, join: bool = True) -> None:
         """Stop the heartbeat; by default WAIT for the thread to exit so

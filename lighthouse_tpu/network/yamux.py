@@ -136,8 +136,10 @@ class Stream:
     def close(self) -> None:
         """Half-close our sending direction (FIN). Best-effort at
         teardown: the peer (and its socket) may already be gone."""
-        if not self.send_closed:
+        with self.cv:
+            already = self.send_closed
             self.send_closed = True
+        if not already:                 # exactly one FIN, racing closers
             try:
                 self.session._send(encode_frame(TYPE_DATA, FLAG_FIN,
                                                 self.id))

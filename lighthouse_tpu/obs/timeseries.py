@@ -108,7 +108,14 @@ class SlotSampler:
         return arr
 
     def sample(self, slot: int) -> None:
-        """Snapshot every catalog metric into the row for ``slot``."""
+        """Snapshot every catalog metric into the row for ``slot``.
+
+        The same-slot re-sample merge below mutates ``_series`` rows in
+        place; every branch (merge or fresh row) runs under ``_lock``,
+        which graftrace pins: the data-race model classifies all eight
+        sampler attributes 'guarded', and test_graftrace.py asserts the
+        file stays race-clean (PR 16 satellite audit — no fix needed).
+        """
         catalog = _catalog()           # import (if any) outside the lock
         slot = int(slot)
         with self._lock:

@@ -28,6 +28,9 @@ class MonitoringService:
         self.sent = 0
         self.errors = 0
         self._stop = threading.Event()
+        # guards _thread: two start() calls (config reload racing boot)
+        # must not leak an unstoppable pusher — graftrace data-race fix
+        self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
 
     def payload(self) -> list[dict]:
@@ -53,21 +56,28 @@ class MonitoringService:
         try:
             with urlrequest.urlopen(req, timeout=5) as r:
                 r.read()
-            self.sent += 1
+            with self._lock:
+                self.sent += 1
             return True
         except Exception:
-            self.errors += 1
+            with self._lock:
+                self.errors += 1
             return False
 
     def start(self) -> None:
         def loop():
             while not self._stop.wait(self.period):
                 self.push_once()
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(target=loop, daemon=True)
+            self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
+        with self._lock:
+            thread = self._thread
             self._thread = None
+        if thread is not None:
+            thread.join(timeout=2)

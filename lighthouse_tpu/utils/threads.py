@@ -12,7 +12,9 @@ group they own and `join_all()` in their stop path *before* closing the
 resources those threads touch. Threads stay daemonic (a wedged peer
 must never block interpreter exit) — the join timeout bounds shutdown.
 graftlint's thread-lifecycle rule recognizes ``group.spawn(...)`` as an
-accounted-for spawn.
+accounted-for spawn, and graftrace's data-race rule treats the spawn
+target as a thread-boundary escape: the receiving class is seeded into
+the shared-state model and its lockset discipline checked (PR 16).
 """
 from __future__ import annotations
 

@@ -67,12 +67,33 @@ def thread_exceptions():
     return _THREAD_EXCEPTIONS
 
 
+def _locksan_reports(config):
+    if not config.getoption("--sanitize-locks", default=False):
+        return []
+    from lighthouse_tpu.analysis import locksan
+    return locksan.REPORTS
+
+
 def pytest_sessionfinish(session, exitstatus):
     if _THREAD_EXCEPTIONS and session.exitstatus == 0:
+        session.exitstatus = 1
+    if _locksan_reports(session.config) and session.exitstatus == 0:
         session.exitstatus = 1
 
 
 def pytest_terminal_summary(terminalreporter):
+    reports = _locksan_reports(terminalreporter.config)
+    if reports:
+        terminalreporter.section(
+            "graftrace lock sanitizer reports (session FAILED)")
+        for r in reports:
+            terminalreporter.write_line("  " + r.render())
+    elif terminalreporter.config.getoption("--sanitize-locks",
+                                           default=False):
+        armed = getattr(terminalreporter.config, "_locksan_armed", [])
+        terminalreporter.write_line(
+            f"graftrace lock sanitizer: 0 reports "
+            f"({len(armed)} armed classes)")
     if not _THREAD_EXCEPTIONS:
         return
     terminalreporter.section("uncaught thread exceptions (session FAILED)")
@@ -92,6 +113,12 @@ def pytest_addoption(parser):
         help="run kernel tests with jax_debug_nans and "
              "jax_numpy_rank_promotion='raise' (slower, catches silent "
              "NaNs and accidental broadcasts)")
+    parser.addoption(
+        "--sanitize-locks", action="store_true", default=False,
+        help="arm the graftrace lock sanitizer: every attribute the "
+             "static data-race model proves lock-guarded is checked at "
+             "runtime — a cross-thread write without the guard held "
+             "fails the session (analysis/locksan.py)")
 
 
 def pytest_configure(config):
@@ -104,3 +131,12 @@ def pytest_configure(config):
             import jax
             jax.config.update("jax_debug_nans", True)
             jax.config.update("jax_numpy_rank_promotion", "raise")
+    if config.getoption("--sanitize-locks"):
+        # configure runs before any test module imports product code,
+        # so the lock-factory patch catches every instance the tests
+        # will create; arming installs the descriptors on the classes
+        # the static model proved guarded
+        from lighthouse_tpu.analysis import locksan
+        locksan.install_lock_tracking()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        config._locksan_armed = locksan.arm_repo(repo)

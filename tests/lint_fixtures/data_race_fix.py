@@ -1,0 +1,145 @@
+"""data-race fixture: every race pattern exactly once, plus the safe
+shapes the rule must stay silent on.
+
+Seeded markers sit on the exact lines the rule must fire on (and
+nothing else); each marker's suffix names the expected category,
+asserted by test_graftrace.py.
+"""
+import threading
+
+
+class WriteNoLock:
+    """The attribute is guarded in one method and bare in another: the
+    guarded reader can observe the torn reset."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def reset(self):
+        self.total = 0  # seeded write-no-lock
+
+
+class LockMix:
+    """Every write is locked — by a different lock each time, so the
+    writers do not exclude each other."""
+
+    def __init__(self):
+        self._alpha = threading.Lock()
+        self._beta = threading.Lock()
+        self.state = 0
+
+    def set_via_alpha(self):
+        with self._alpha:
+            self.state = 1
+
+    def set_via_beta(self):
+        with self._beta:
+            self.state = 2  # seeded lock-mix
+
+
+class CheckThenAct:
+    """Unlocked test decides a locked write: two threads can both see
+    None and both create — the classic lost-update TOCTOU."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = None
+
+    def ensure(self):
+        if self._pool is None:  # seeded check-then-act
+            with self._lock:
+                self._pool = object()
+        return self._pool
+
+    def close(self):
+        with self._lock:
+            self._pool = None
+
+
+class SpawnedWorker:
+    """No lock anywhere: the field is written on the spawned thread and
+    read from the caller's — unsynchronized shared mutation."""
+
+    def __init__(self):
+        self.status = "idle"
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self.status = "running"  # seeded write-no-lock
+
+    def report(self):
+        return self.status
+
+
+# -- true negatives: none of these may fire ----------------------------------
+
+
+class CleanService:
+    """Flag publish, lifecycle handle, and a consistently-guarded
+    counter: all safe shapes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = False
+        self._t = None
+        self.done = 0
+
+    def start(self):
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while not self._stop:       # reads a bool flag: atomic snapshot
+            with self._lock:
+                self.done += 1
+
+    def stop(self):
+        self._stop = True           # literal flag publish: exempt
+        if self._t is not None:
+            self._t.join(timeout=1)
+
+    def count(self):
+        with self._lock:            # same guard everywhere: guarded
+            return self.done
+
+
+class DoubleChecked:
+    """The unlocked outer test is a fast path; the locked re-test
+    decides — sanctioned double-checked publication."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inst = None
+
+    def get(self):
+        if self._inst is None:
+            with self._lock:
+                if self._inst is None:
+                    self._inst = object()
+        return self._inst
+
+
+class InitOnlyConfig:
+    """Written only during __init__, read by the spawned thread:
+    safe publication (read-only after construction)."""
+
+    def __init__(self, limit):
+        self.limit = limit
+        self._q = threading.Condition()
+
+    def start(self):
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        with self._q:
+            self._q.wait(timeout=0.01)
+        return self.limit

@@ -39,6 +39,7 @@ RULE_FIXTURE = {
     "shutdown-order": "shutdown_order_fix.py",
     "compile-budget": "compile_budget_fix.py",
     "cow-discipline": "cow_discipline_fix.py",
+    "data-race": "data_race_fix.py",
     "store-atomicity": "store_atomicity_fix.py",
     "serving-cache-discipline": "serving_cache_discipline_fix.py",
 }
@@ -74,12 +75,12 @@ def test_repo_is_clean_under_all_rules():
     assert not report["violations"], \
         "\n".join(v.render() for v in report["violations"])
     assert not report["stale_baseline"], report["stale_baseline"]
-    assert len(report["rules"]) >= 10
+    assert len(report["rules"]) >= 14
     assert report["elapsed_s"] < 30
 
 
 def test_full_tree_lint_stays_fast(tmp_path):
-    """The CI wall-time gate: a cache-warm full-tree run of all ten
+    """The CI wall-time gate: a cache-warm full-tree run of all the
     rules must finish in ≤5 s — the content-hash cache (not luck) is
     what keeps this true as the tree grows, so the gate runs against a
     freshly-warmed cache the way every run after the first behaves."""
@@ -129,7 +130,7 @@ def test_cli_json_is_clean_and_exits_zero():
     assert out.returncode == 0, out.stdout + out.stderr
     data = json.loads(out.stdout)
     assert data["violations"] == []
-    assert len(data["rules"]) >= 10
+    assert len(data["rules"]) >= 14
 
 
 def test_cli_sarif_output(tmp_path):
@@ -156,7 +157,7 @@ def test_cli_changed_filters_to_touched_files():
     out = _run_cli("--changed", "HEAD", "--format", "json", "--no-cache")
     assert out.returncode in (0, 1), out.stdout + out.stderr
     data = json.loads(out.stdout)
-    assert len(data["rules"]) >= 10
+    assert len(data["rules"]) >= 14
     head_clean = subprocess.run(
         ["git", "diff", "--quiet", "HEAD", "--", "lighthouse_tpu"],
         cwd=REPO).returncode == 0

@@ -8,6 +8,7 @@
     python tools/lint/run.py --changed HEAD~1      # report only files touched vs a ref
     python tools/lint/run.py --no-baseline         # raw findings
     python tools/lint/run.py --jobs 4 --no-cache   # per-file stage tuning
+    python tools/lint/run.py --shared-state        # graftrace model dump (triage)
 
 Exit codes: 0 clean (baselined findings allowed), 1 non-baselined
 violations, 2 usage/baseline-format errors. Pure AST — no jax import, so
@@ -61,6 +62,33 @@ def _changed_paths(ref: str) -> set[str] | None:
     return out
 
 
+def _dump_shared_state(paths: list[Path]) -> int:
+    """Triage view for the data-race rule: every modeled class with its
+    seeding, entry methods, and per-attribute lockset verdict."""
+    from lighthouse_tpu.analysis.callgraph import CallGraph, build_facts
+    from lighthouse_tpu.analysis.sharedstate import (
+        build_model, classify_attrs, scan_module,
+    )
+    project = Project.load(REPO, paths)
+    data, facts = {}, {}
+    for m in project.modules:
+        facts[m.relpath] = build_facts(m.tree, m.relpath)
+        scan = scan_module(m.tree, m.relpath)
+        if scan is not None:
+            data[m.relpath] = scan
+    model = build_model(data, CallGraph(facts))
+    for (rel, cls), sc in sorted(model.items()):
+        seeds = ", ".join(sorted(sc.seeded_by)) or "lock-owning only"
+        print(f"{rel}:{sc.line} {cls}  [{seeds}]")
+        if sc.entry_methods:
+            print(f"  entry: {', '.join(sorted(sc.entry_methods))}")
+        for attr, rep in sorted(classify_attrs(sc).items()):
+            guard = f" under {'+'.join(rep.guard)}" if rep.guard else ""
+            print(f"  {attr}: {rep.status}{guard}")
+    print(f"-- {len(model)} shared class(es)")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("paths", nargs="*", type=Path,
@@ -84,7 +112,14 @@ def main(argv: list[str] | None = None) -> int:
                     f"{DEFAULT_CACHE.name} at the repo root)")
     ap.add_argument("--no-cache", action="store_true",
                     help="disable the content-hash cache")
+    ap.add_argument("--shared-state", action="store_true",
+                    help="print the graftrace shared-state model "
+                    "(classes, spawn seeds, per-attr lockset verdicts) "
+                    "instead of a violation report")
     args = ap.parse_args(argv)
+
+    if args.shared_state:
+        return _dump_shared_state(args.paths or [REPO / "lighthouse_tpu"])
 
     rules = all_rules()
     if args.rules:
