@@ -809,6 +809,56 @@ def _write_trace_artifacts(mode: str, out_dir: str) -> str | None:
     return trace_path
 
 
+def _device_block(mode):
+    """graftgauge (ISSUE 17): every BENCH record carries a mandatory
+    device block — platform + chip count, HBM stats or an explicit
+    "unavailable", persistent compile-cache counters, and per-kernel
+    roofline records for the mode's headline kernel.  Never raises."""
+    from lighthouse_tpu.obs import device, jax_accounting, roofline
+    try:
+        block = device.ledger_snapshot()
+    except Exception as exc:
+        return {"error": repr(exc)}
+    counters = jax_accounting.snapshot()
+    block["compile_cache"] = {"hits": counters.get("cache_hits", 0),
+                              "misses": counters.get("cache_misses", 0)}
+    if mode == "tree_hash":
+        # measure the tree-hash inner kernel explicitly: hash_pairs runs
+        # inside shard_map on the sharded path, so it can't carry its
+        # own timing wrapper (trace safety) — the bench measures it from
+        # outside on a representative batch instead
+        try:
+            import jax.numpy as jnp
+            import numpy as np
+            from lighthouse_tpu.ops.sha256 import hash_pairs
+            arr = jnp.asarray(np.arange(2048 * 8,
+                                        dtype=np.uint32).reshape(2048, 8))
+            roofline.measure("tree_hash", hash_pairs, arr)
+        except Exception:
+            pass
+    # fold the mesh programs' roofline records under the mode's headline
+    # kernel name; where nothing roofline-wrapped ran (the single-device
+    # crypto backend path on the CPU fallback, whose per-shape compiles
+    # cost ~10 min each) the record says so explicitly — a cost fetch
+    # would blow the child budget, silence would be a lie
+    kname, prefix = {"bls": ("bls_batch_verify", "bls."),
+                     "tree_hash": ("tree_hash", "merkle.")
+                     }.get(mode, (None, "\x00"))
+    roof = {}
+    for prog, recs in sorted(roofline.snapshot().items()):
+        if kname and (prog == kname or prog.startswith(prefix)):
+            roof.setdefault(kname, []).extend(
+                dict(r, program=prog) for r in recs)
+        else:
+            roof[prog] = recs
+    if kname and kname not in roof:
+        roof[kname] = [{"cost": "unavailable",
+                        "note": "no roofline-wrapped program ran in "
+                                "this mode"}]
+    block["roofline"] = roof
+    return block
+
+
 def child_main():
     import jax
     platform = jax.default_backend()
@@ -902,6 +952,7 @@ def child_main():
             "vs_baseline": round(TARGET_MS / ms, 3),
             "platform": platform,
         }
+    rec["device"] = _device_block(mode)
     if os.environ.get("LHTPU_BENCH_TRACE"):
         trace_path = _write_trace_artifacts(mode, _REPO)
         if trace_path is not None:
@@ -940,17 +991,53 @@ def _get_path(rec, dotted):
     return float(cur) if isinstance(cur, (int, float)) else None
 
 
+def _device_platform(rec: dict) -> str | None:
+    dev = rec.get("device")
+    if not isinstance(dev, dict):
+        return None
+    plat = dev.get("platform")
+    return plat if isinstance(plat, str) and plat != "unavailable" \
+        else None
+
+
 def compare_records(old: dict, new: dict,
                     limit: float = REGRESSION_LIMIT) -> dict:
     """Diff two bench records over GATED_METRICS.  Returns a report dict;
-    report["ok"] is False when any gated metric regressed past `limit`."""
-    compared, skipped = [], []
+    report["ok"] is False when any gated metric regressed past `limit`.
+
+    Device-sensitive metrics (those with a platform-label key) are
+    guarded twice: the per-metric platform labels as before, and — since
+    graftgauge — the records' mandatory ``device`` blocks.  Disagreeing
+    device blocks refuse the comparison outright (``platform_mismatch``);
+    records predating the device block (r01–r06) still compare via their
+    labels but the report carries a ``platform_notes`` entry flagging
+    every accelerator-flagship metric those records measured on the XLA
+    CPU fallback."""
+    compared, skipped, notes = [], [], []
+    dev_old, dev_new = _device_platform(old), _device_platform(new)
     for key, direction, plat_key in GATED_METRICS:
         ov, nv = _get_path(old, key), _get_path(new, key)
         if ov is None or nv is None or ov <= 0 or nv <= 0:
             skipped.append({"metric": key,
                             "why": "missing or non-positive in one record"})
             continue
+        if plat_key is not None:
+            if dev_old and dev_new and dev_old != dev_new:
+                skipped.append({"metric": key,
+                                "why": f"platform_mismatch (device "
+                                       f"blocks disagree: {dev_old} vs "
+                                       f"{dev_new})"})
+                continue
+            for which, rec_, dev in (("old", old, dev_old),
+                                     ("new", new, dev_new)):
+                if dev is None and rec_.get(plat_key) == "cpu":
+                    notes.append({
+                        "metric": key, "record": which,
+                        "note": "device-sensitive metric measured on "
+                                "the XLA CPU fallback by a record "
+                                "predating the graftgauge device block "
+                                f"({plat_key}=cpu); not evidence for "
+                                "accelerator claims"})
         if plat_key is not None and old.get(plat_key) != new.get(plat_key):
             skipped.append({"metric": key,
                             "why": f"platform mismatch "
@@ -972,9 +1059,12 @@ def compare_records(old: dict, new: dict,
                          "status": status})
     regressions = [c["metric"] for c in compared
                    if c["status"] == "regression"]
-    return {"mode": "against", "limit_pct": round(limit * 100, 1),
-            "compared": compared, "skipped": skipped,
-            "regressions": regressions, "ok": not regressions}
+    report = {"mode": "against", "limit_pct": round(limit * 100, 1),
+              "compared": compared, "skipped": skipped,
+              "regressions": regressions, "ok": not regressions}
+    if notes:
+        report["platform_notes"] = notes
+    return report
 
 
 def _unwrap_record(doc: dict) -> dict:
@@ -1153,40 +1243,17 @@ def _stf_record(force_cpu: bool):
             os.environ["LHTPU_BENCH"] = prev
 
 
-_PROBE_STAGES = [("import", "import jax"),
-                 ("devices", "import jax; jax.devices()")]
-
-
 def tpu_probe(timeout=90):
-    """Staged TPU-acquisition probe (satellite): how far does JAX get on
-    this host, under default init and under JAX_PLATFORMS=tpu?  Each
-    stage is its own subprocess with a hard timeout, so a wedged libtpu
-    acquisition can't hang the bench — the record says exactly which
-    stage died and how long it took."""
-    out = {"timeout_s": timeout}
-    for label, extra in (("default", {}),
-                         ("forced_tpu", {"JAX_PLATFORMS": "tpu"})):
-        env = _child_env(force_cpu=False)
-        env.pop("LHTPU_BENCH_CHILD", None)
-        env.update(extra)
-        stage_reached = None
-        stages = {}
-        for stage, code in _PROBE_STAGES:
-            stage_reached = stage
-            t0 = time.perf_counter()
-            try:
-                proc = subprocess.run(
-                    [sys.executable, "-c", code], env=env, cwd=_REPO,
-                    capture_output=True, text=True, timeout=timeout)
-                rc = proc.returncode
-            except subprocess.TimeoutExpired:
-                rc = None
-            wall = round(time.perf_counter() - t0, 2)
-            stages[stage] = {"wall_s": wall, "rc": rc}
-            if rc != 0:
-                break
-        out[label] = {"stage_reached": stage_reached, "stages": stages}
-    return out
+    """Staged TPU-acquisition probe, promoted into the shared graftgauge
+    device-health section (obs/device.staged_probe; also runnable
+    standalone via ``tools/obs/doctor.py --probe``).  The bench feeds
+    its child env so the probe sees the same compilation-cache +
+    PYTHONPATH setup as the measurement children.  obs.device imports no
+    jax at module scope, so the parent stays jax-free."""
+    from lighthouse_tpu.obs import device
+    env = _child_env(force_cpu=False)
+    env.pop("LHTPU_BENCH_CHILD", None)
+    return device.staged_probe(timeout=timeout, env=env, cwd=_REPO)
 
 
 def _replay_record(force_cpu: bool):
@@ -1273,6 +1340,19 @@ def main():
                     rec["bls_n_sigs"] = bls_rec.get("n_sigs")
                     rec["bls_baseline_source"] = \
                         bls_rec.get("baseline_source")
+                    # fold the BLS child's per-kernel roofline into the
+                    # merged record's device block (the block itself
+                    # came from the tree-hash child)
+                    bdev = bls_rec.get("device")
+                    if isinstance(rec.get("device"), dict) \
+                            and isinstance(bdev, dict):
+                        broof = (bdev.get("roofline") or {})
+                        rec["device"].setdefault("roofline", {})[
+                            "bls_batch_verify"] = broof.get(
+                                "bls_batch_verify") or [
+                                    {"cost": "unavailable"}]
+                        rec["device"]["bls_child_platform"] = \
+                            bdev.get("platform")
                 stf_rec = _stf_record(force_cpu)
                 if stf_rec is not None and stf_rec.get("value"):
                     rec["epoch_ms_1m"] = stf_rec["epoch_ms_1m"]
@@ -1319,6 +1399,11 @@ def main():
         "metric": metric,
         "value": None, "unit": "error", "vs_baseline": 0.0,
         "error": " | ".join(errors)[-1000:],
+        # the device block is mandatory on every record; the parent
+        # never imports jax, so on total child failure it is honest
+        # about knowing nothing
+        "device": {"platform": "unavailable", "device_kind": "unavailable",
+                   "chip_count": 0, "hbm": "unavailable"},
     }))
 
 

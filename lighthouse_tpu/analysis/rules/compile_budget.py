@@ -28,6 +28,13 @@ Mechanics (on the shared interprocedural engine):
    (``host_prepare(..., lanes, small=...)``) before keying — pow-of-two
    bucketing (``(len(x)-1).bit_length()``) is deliberately NOT
    sanctioned: it bounds compiles logarithmically, not at two.
+5. **roofline pairing** (graftgauge, ISSUE 17): every scoped memoized
+   jit factory must build its program through
+   ``obs.roofline.track_roofline`` — the wrapper that pairs this rule's
+   static budget with dynamic compile accounting AND per-program
+   cost_analysis/roofline records.  A factory returning a bare
+   ``jax.jit(...)`` (or only the older ``track_compiles``) is flagged:
+   its programs would run unmetered against the platform peak table.
 """
 from __future__ import annotations
 
@@ -159,10 +166,38 @@ class CompileBudgetRule(Rule):
         if not programs:
             return []
 
+        out = []
+        data = ctx.data_for(self.name)
+
+        # 1b. roofline pairing: the factory must hand its jit program to
+        # obs.roofline.track_roofline, the wrapper that pairs this static
+        # budget with dynamic compile accounting + cost_analysis records
+        # (graftgauge); a bare jax.jit(...) runs unmetered
+        for rel, qual in sorted(programs):
+            f = (data.get(rel) or {}).get("funcs", {}).get(qual)
+            if f is None:
+                continue
+            names = [c[0] for c in f["calls"]]
+            if any(n.split(".")[-1] == "track_roofline" for n in names):
+                continue
+            jit_lines = [line for name, line, _k in f["calls"]
+                         if name.split(".")[-1] == "jit"]
+            line = min(jit_lines) if jit_lines \
+                else min(c[1] for c in f["calls"])
+            out.append(Violation(
+                rule=self.name, path=rel, line=line,
+                message=(f"memoized jit factory '{qual}' bypasses the "
+                         "roofline wrapper — build the program with "
+                         "obs.roofline.track_roofline(name, jax.jit(...)) "
+                         "so compile accounting and per-program "
+                         "cost_analysis/roofline records stay paired "
+                         "(graftgauge)"),
+                symbol=qual))
+
         # 2. resolve every scoped call site to a program
         #    site: (program, key tuple, rel, line, caller qual)
         sites = []
-        for rel, d in ctx.data_for(self.name).items():
+        for rel, d in data.items():
             for qual, f in d["funcs"].items():
                 for name, line, keys in f["calls"]:
                     for cand in ctx.graph.resolve_call(rel, qual, name):
@@ -172,7 +207,6 @@ class CompileBudgetRule(Rule):
                             break
         sites.sort(key=lambda s: (s[2], s[3]))
 
-        out = []
         # 3. the two-shape budget per program
         seen_keys: dict[tuple, list] = {}
         for prog, key, rel, line, qual, _assigns in sites:

@@ -206,6 +206,7 @@ CATALOG: dict[str, tuple[str, str]] = {
     "process_resident_memory_bytes": ("gauge", "RSS"),
     "system_load_1m": ("gauge", "1-minute load average"),
     "system_disk_free_bytes": ("gauge", "Free disk on the data volume"),
+    "process_open_fds": ("gauge", "Open file descriptors"),
     # -- graftscope tracing (obs/) ----------------------------------------
     "beacon_block_pipeline_seconds":
         ("hist", "Gossip arrival -> imported, whole pipeline trace"),
@@ -275,6 +276,20 @@ CATALOG: dict[str, tuple[str, str]] = {
         ("counter", "Accounted device->host bytes (obs.host_readback)"),
     "jax_jit_cache_entries":
         ("gauge", "Trace-cache entries of the last tracked jit program"),
+    # -- graftgauge device ledger + roofline (obs/device, obs/roofline) ---
+    "device_hbm_bytes_in_use":
+        ("gauge", "HBM bytes in use summed across devices (absent on "
+                  "backends without memory_stats, e.g. XLA CPU)"),
+    "device_hbm_bytes_limit":
+        ("gauge", "HBM byte limit summed across devices"),
+    "roofline_utilization_ratio":
+        ("gauge", "Achieved FLOP/s over nominal platform peak for the "
+                  "last roofline-timed program call"),
+    "jax_compile_cache_hits_total":
+        ("counter", "Persistent compile-cache hits (jax.monitoring "
+                    "/jax/compilation_cache events)"),
+    "jax_compile_cache_misses_total":
+        ("counter", "Persistent compile-cache misses"),
 }
 
 #: Histograms declared for dashboard parity but fed outside the node

@@ -43,7 +43,8 @@ OVERLAY_MAX_LEAVES = 2048
 
 #: process-wide CoW accounting, mirrored into graftscope counters when
 #: the metrics module is loaded (bench.py fork_fanout reads the deltas).
-STATS = {"chunks_materialized": 0, "chunks_shared": 0, "rebases": 0}
+STATS = {"chunks_materialized": 0, "chunks_shared": 0, "rebases": 0,
+         "bytes_materialized": 0, "bytes_shared": 0}
 
 
 def _count_metric(name: str, amount: int) -> None:
@@ -132,6 +133,7 @@ class CowColumn(np.lib.mixins.NDArrayOperatorsMixin):
             self._host_shared = True
         out._host_shared = self._host_tree is not None
         STATS["chunks_shared"] += len(self._chunks)
+        STATS["bytes_shared"] += sum(c.nbytes for c in self._chunks)
         _count_metric("state_cow_chunks_shared", len(self._chunks))
         return out
 
@@ -145,6 +147,7 @@ class CowColumn(np.lib.mixins.NDArrayOperatorsMixin):
             self._rc[c] = [1]
             self._contig = False
             STATS["chunks_materialized"] += 1
+            STATS["bytes_materialized"] += self._chunks[c].nbytes
             _count_metric("state_cow_chunks_materialized", 1)
         return self._chunks[c]
 

@@ -16,7 +16,10 @@ from .tracing import (
     chrome_trace, clear, current_context, current_span, set_slot_clock,
     snapshot, span,
 )
-from . import causal, critpath, flight, graftwatch, occupancy, slo, timeseries
+from . import (
+    causal, critpath, device, flight, graftwatch, occupancy, roofline,
+    slo, timeseries,
+)
 
 __all__ = [
     "SPAN_KINDS", "Span", "annotate", "attach", "capture",
@@ -26,6 +29,6 @@ __all__ = [
     "account_transfer", "host_readback",
     "install_monitoring", "jax_counters", "track_compiles",
     "render_table", "summarize_chrome", "summarize_spans",
-    "causal", "critpath", "flight", "graftwatch", "occupancy", "slo",
-    "timeseries",
+    "causal", "critpath", "device", "flight", "graftwatch", "occupancy",
+    "roofline", "slo", "timeseries",
 ]

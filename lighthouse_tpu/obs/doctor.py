@@ -67,6 +67,10 @@ def _stats(vals: list[float]) -> dict:
 _COSIGNALS = [
     ("jax_compile_total", "delta", "runtime XLA recompiles"),
     ("jax_compile_seconds_total", "delta", "XLA compile seconds"),
+    ("jax_compile_cache_misses_total", "delta",
+     "persistent compile-cache misses"),
+    ("device_hbm_bytes_in_use", "level", "HBM bytes in use"),
+    ("process_resident_memory_bytes", "level", "host RSS bytes"),
     ("jax_transfer_host_to_device_bytes_total", "delta",
      "host->device transfer bytes"),
     ("jax_transfer_device_to_host_bytes_total", "delta",
@@ -153,6 +157,7 @@ def diagnose(doc: dict) -> dict:
         "window_slots": len(slots),
         "span_events": len(spans),
         "jax": doc.get("jax") or {},
+        "device": doc.get("device"),
         "chains": doc.get("chains") or [],
         "processors": doc.get("processors") or [],
         "sync": doc.get("sync"),
@@ -187,6 +192,56 @@ def render(diag: dict) -> str:
             f"{_fmt_num(jax.get('compiles'))} compiles, "
             f"{_fmt_num(jax.get('h2d_bytes'))} B h2d, "
             f"{_fmt_num(jax.get('d2h_bytes'))} B d2h")
+    # device sections are post-ISSUE-17 dumps only; older dumps lack
+    # the key and render nothing (same contract as sync below)
+    dev = diag.get("device")
+    if isinstance(dev, dict):
+        if "error" in dev:
+            lines.append(f"  device: <{dev['error']}>")
+        else:
+            hbm = dev.get("hbm")
+            if isinstance(hbm, list):
+                in_use = sum(r.get("bytes_in_use") or 0 for r in hbm)
+                limit = sum(r.get("bytes_limit") or 0 for r in hbm)
+                hbm_s = f"HBM {_fmt_num(in_use)}/{_fmt_num(limit)} B"
+            else:
+                hbm_s = f"HBM {hbm}"
+            lines.append(
+                f"  device: {dev.get('platform', '?')} "
+                f"({dev.get('device_kind', '?')}) x "
+                f"{_fmt_num(dev.get('chip_count'))}, {hbm_s}")
+            cc = dev.get("compile_cache") or {}
+            if "error" not in cc and cc:
+                lines.append(
+                    f"    compile cache: {_fmt_num(cc.get('hits'))} hits, "
+                    f"{_fmt_num(cc.get('misses'))} misses")
+            roof = dev.get("roofline") or {}
+            if "error" not in roof:
+                for prog in sorted(roof):
+                    for rec in roof[prog]:
+                        if not isinstance(rec, dict):
+                            continue
+                        if rec.get("cost") == "unavailable":
+                            lines.append(
+                                f"    roofline {prog}: cost unavailable "
+                                f"({rec.get('platform', '?')})")
+                            continue
+                        util = rec.get("utilization_of_peak")
+                        util_s = ("-" if util is None
+                                  else f"{util * 100:.2g}% of peak")
+                        lines.append(
+                            f"    roofline {prog}: "
+                            f"{_fmt_num(rec.get('flops'))} flops, "
+                            f"{_fmt_num(rec.get('bytes_accessed'))} B, "
+                            f"{util_s} ({rec.get('platform', '?')})")
+            attr = dev.get("attribution") or {}
+            for owner in sorted(attr):
+                for label in sorted(attr[owner]):
+                    rec = attr[owner][label]
+                    lines.append(
+                        f"    attributed {owner}/{label}: "
+                        f"{_fmt_num(rec.get('live_bytes'))} B live "
+                        f"(peak {_fmt_num(rec.get('peak_bytes'))})")
     for ch in diag.get("chains") or []:
         if "error" in ch:
             lines.append(f"  chain: <{ch['error']}>")

@@ -13,6 +13,9 @@ offline:
   stitched cross-node when the ring holds an in-process fleet)
 - the full graftwatch time-series window
 - ``jax_accounting.snapshot()`` (compiles, compile seconds, transfers)
+- the graftgauge device ledger (``obs/device.flight_section``: platform,
+  HBM stats or explicit ``unavailable``, subsystem attribution, roofline
+  records, persistent compile-cache hit/miss counts — ISSUE 17)
 - beacon-processor queue depths / drop / high-water counts
 - a fork-choice head summary per registered chain
 - a sync summary per chain (state, in-flight request deadlines, peer
@@ -39,7 +42,7 @@ import sys
 import tempfile
 import threading
 
-from . import jax_accounting, tracing
+from . import device, jax_accounting, tracing
 from ..utils.log_buffer import global_log_buffer
 
 FORMAT_VERSION = 1
@@ -194,6 +197,7 @@ class FlightRecorder:
         doc["chrome_trace"] = tracing.chrome_trace()
         doc["critpath"] = _critpath_summary()
         doc["jax"] = jax_accounting.snapshot()
+        doc["device"] = device.flight_section()
         if w is not None:
             doc["incidents"] = [i.to_dict()
                                 for i in w.engine.all_incidents()]

@@ -22,7 +22,7 @@ from __future__ import annotations
 import threading
 import weakref
 
-from . import flight, occupancy, slo, timeseries
+from . import device, flight, occupancy, slo, timeseries
 
 
 class Graftwatch:
@@ -122,6 +122,8 @@ class Graftwatch:
         # fold stage busy-seconds into the occupancy gauges before the
         # snapshot so the sampler rows carry this slot's fractions
         occupancy.publish()
+        # device/HBM + host-health gauges land in the same slot row
+        device.publish()
         self.sampler.sample(slot)
         opened = self.engine.evaluate(slot, tuple(self.chains()))
         if opened and self.auto_dump:

@@ -39,6 +39,8 @@ _counters = {
     "compile_seconds": 0.0,
     "h2d_bytes": 0,
     "d2h_bytes": 0,
+    "cache_hits": 0,
+    "cache_misses": 0,
 }
 _monitoring_installed = False
 
@@ -88,9 +90,22 @@ def host_readback(x):
     return np.asarray(x)
 
 
+def _record_cache_event(hit: bool) -> None:
+    """Persistent-compile-cache hit/miss accounting (tests and the
+    jax.monitoring listener both land here)."""
+    key = "cache_hits" if hit else "cache_misses"
+    with _lock:
+        _counters[key] += 1
+    md = _metrics()
+    if md is not None:
+        md.count("jax_compile_cache_hits_total" if hit
+                 else "jax_compile_cache_misses_total", 1)
+
+
 def install_monitoring() -> bool:
-    """Route jax.monitoring compile-duration events into the catalog.
-    Idempotent; returns whether the listener is installed."""
+    """Route jax.monitoring compile-duration + persistent-compile-cache
+    events into the catalog.  Idempotent; returns whether the listeners
+    are installed."""
     global _monitoring_installed
     if _monitoring_installed:
         return True
@@ -110,6 +125,17 @@ def install_monitoring() -> bool:
                 md.count("jax_compile_seconds_total", duration)
 
     jm.register_event_duration_secs_listener(_on_duration)
+    # the persistent compile cache announces itself through bare events:
+    # /jax/compilation_cache/cache_hits on a hit (compiler.py) and
+    # /jax/compilation_cache/cache_misses on a miss (compilation_cache.py)
+    if hasattr(jm, "register_event_listener"):
+        def _on_event(event: str, **kw) -> None:
+            if event.endswith("/compilation_cache/cache_hits"):
+                _record_cache_event(True)
+            elif event.endswith("/compilation_cache/cache_misses"):
+                _record_cache_event(False)
+
+        jm.register_event_listener(_on_event)
     _monitoring_installed = True
     return True
 

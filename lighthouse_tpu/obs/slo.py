@@ -47,6 +47,12 @@ hand:
                             pipeline commits >= 1 block per
                             slot-equivalent — a stalled stage surfaces
                             instead of wedging sync (ISSUE 14)
+``hbm_headroom``            device HBM headroom (1 - in_use/limit) stays
+                            above budget; unevaluable where the backend
+                            exposes no memory_stats (graftgauge)
+``compile_cache_hit_ratio`` the persistent compile cache keeps
+                            absorbing XLA compiles after warmup
+                            (graftgauge; PERF_MODEL §4)
 ==========================  ============================================
 """
 from __future__ import annotations
@@ -266,6 +272,47 @@ def _check_serving_shed_rate(budget_ratio: float,
     return check
 
 
+def _check_hbm_headroom(budget_ratio: float) -> Check:
+    """Breach when HBM headroom (1 - in_use/limit) drops below budget.
+    Unevaluable where the backend exposes no memory_stats (XLA CPU) —
+    graftgauge's honesty contract: absent stats are not clean-by-lie,
+    they are explicitly not evaluated (ISSUE 17)."""
+    def check(ctx: EvalContext):
+        in_use = ctx.sampler.latest("device_hbm_bytes_in_use")
+        limit = ctx.sampler.latest("device_hbm_bytes_limit")
+        if in_use is None or limit is None or limit <= 0:
+            return None, False, "HBM stats unavailable on this platform"
+        headroom = 1.0 - in_use / limit
+        return headroom, headroom < budget_ratio, (
+            f"HBM headroom {headroom:.2f} "
+            f"({in_use / 2**30:.2f}/{limit / 2**30:.2f} GiB in use)")
+    return check
+
+
+def _check_compile_cache_hit_ratio(budget_ratio: float,
+                                   warmup_slots: int,
+                                   min_events: int) -> Check:
+    """Persistent-compile-cache hit ratio over the window stays above
+    budget after warmup (PERF_MODEL §4 cache hygiene, made observable
+    via jax.monitoring events).  The warmup gate matters: the first run
+    on a cold cache is all misses by design."""
+    def check(ctx: EvalContext):
+        _, hits = ctx.sampler.series("jax_compile_cache_hits_total")
+        _, misses = ctx.sampler.series("jax_compile_cache_misses_total")
+        h = float(np.nansum(hits)) if hits.size else 0.0
+        m = float(np.nansum(misses)) if misses.size else 0.0
+        if h + m < min_events:
+            return None, False, \
+                f"only {h + m:.0f} cache events in window (< {min_events})"
+        if ctx.slots_seen <= warmup_slots:
+            return None, False, \
+                f"warmup ({h:.0f} hits / {m:.0f} misses so far)"
+        ratio = h / (h + m)
+        return ratio, ratio < budget_ratio, \
+            f"compile-cache hit ratio {ratio:.2f} over {h + m:.0f} events"
+    return check
+
+
 def default_slos(pipeline_p95_s: float = 5.0,
                  head_lag_slots: int = 1,
                  compile_warmup_slots: int = 8,
@@ -280,7 +327,11 @@ def default_slos(pipeline_p95_s: float = 5.0,
                  replay_stall_slots: int = 3,
                  # propagation subsumes the whole verify->import pipeline,
                  # so its budget tracks pipeline_p95_s, not gossip alone
-                 propagation_p95_s: float = 5.0) -> list[SLO]:
+                 propagation_p95_s: float = 5.0,
+                 hbm_headroom_ratio: float = 0.10,
+                 compile_cache_hit_ratio: float = 0.5,
+                 compile_cache_warmup_slots: int = 8,
+                 compile_cache_min_events: int = 4) -> list[SLO]:
     return [
         SLO("block_pipeline_p95", "beacon_block_pipeline_seconds",
             pipeline_p95_s,
@@ -338,6 +389,19 @@ def default_slos(pipeline_p95_s: float = 5.0,
             "publish -> import block propagation p95 across the fleet "
             "stays inside budget (graftpath, ISSUE 13)",
             _check_propagation_p95(propagation_p95_s)),
+        SLO("hbm_headroom", "device_hbm_bytes_in_use",
+            hbm_headroom_ratio,
+            "device HBM headroom stays above budget; unevaluable where "
+            "the backend exposes no memory_stats (graftgauge, ISSUE 17)",
+            _check_hbm_headroom(hbm_headroom_ratio),
+            resolve_after=2),
+        SLO("compile_cache_hit_ratio", "jax_compile_cache_hits_total",
+            compile_cache_hit_ratio,
+            "the persistent compile cache keeps absorbing XLA "
+            "compilations after warmup (PERF_MODEL §4; graftgauge)",
+            _check_compile_cache_hit_ratio(compile_cache_hit_ratio,
+                                           compile_cache_warmup_slots,
+                                           compile_cache_min_events)),
     ]
 
 

@@ -22,7 +22,9 @@ try:                              # jax >= 0.4.35 exports it at top level
 except ImportError:               # older jax: experimental location
     from jax.experimental.shard_map import shard_map
 
-from ..obs.jax_accounting import host_readback, track_compiles
+from ..obs import device
+from ..obs.jax_accounting import host_readback
+from ..obs.roofline import track_roofline
 from ..ops.bls12_381 import (
     final_exponentiation,
     fp12_eq,
@@ -51,12 +53,15 @@ def _local_masked_product(lpx, lpy, lqx, lqy, lmask):
 # Memoized jitted programs per (mesh, axis): a fresh jit(shard_map(...))
 # per call would rebuild the wrapper — and the shard_map closure under it
 # — every time, so every call re-traced (graftlint: recompile-hazard).
-# track_compiles() is the dynamic complement: a shape leak past the
-# memoization shows up as jax_compile_total, not a silent re-trace.
+# track_roofline() is the dynamic complement: compile accounting (a shape
+# leak past the memoization shows up as jax_compile_total) PLUS each
+# program's cost_analysis + measured wall time scored against the
+# platform peak table (graftgauge) — the compile-budget lint rule flags
+# factories here that bypass it.
 
 @functools.lru_cache(maxsize=None)
 def _miller_product_fn(mesh: Mesh, axis: str):
-    return track_compiles("bls.miller_product", jax.jit(shard_map(
+    return track_roofline("bls.miller_product", jax.jit(shard_map(
         _local_miller_product, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(axis))))
@@ -64,7 +69,7 @@ def _miller_product_fn(mesh: Mesh, axis: str):
 
 @functools.lru_cache(maxsize=None)
 def _masked_product_fn(mesh: Mesh, axis: str):
-    return track_compiles("bls.masked_product", jax.jit(shard_map(
+    return track_roofline("bls.masked_product", jax.jit(shard_map(
         _local_masked_product, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(axis))))
@@ -73,11 +78,11 @@ def _masked_product_fn(mesh: Mesh, axis: str):
 @functools.lru_cache(maxsize=None)
 def _scalar_mul_fns(mesh: Mesh, axis: str):
     import lighthouse_tpu.ops.bls12_381 as k
-    g1 = track_compiles("bls.g1_scalar_mul", jax.jit(shard_map(
+    g1 = track_roofline("bls.g1_scalar_mul", jax.jit(shard_map(
         k.g1_scalar_mul, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(axis), P(axis), P(axis)))))
-    g2 = track_compiles("bls.g2_scalar_mul", jax.jit(shard_map(
+    g2 = track_roofline("bls.g2_scalar_mul", jax.jit(shard_map(
         k.g2_scalar_mul, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(axis), P(axis), P(axis)))))
@@ -95,10 +100,12 @@ def sharded_pairing_check(mesh: Mesh, px, py, qx, qy,
     stage 2 (tiny product + the shared final exponentiation + identity
     check) runs as separate cached programs on the gathered result.  One
     fused program here was the round-2 ~12-minute compile."""
-    partials = _miller_product_fn(mesh, axis)(px, py, qx,
-                                              qy)  # [n_dev, 2, 3, 2, 32]
-    out = final_exponentiation(fp12_product(partials))
-    return fp12_eq(out[None], fp12_one_like((1,)))[0]
+    with device.hbm_watermark("parallel.bls"):
+        device.attribute("parallel.bls", "pairing_inputs", px, py, qx, qy)
+        partials = _miller_product_fn(mesh, axis)(px, py, qx,
+                                                  qy)  # [n_dev,2,3,2,32]
+        out = final_exponentiation(fp12_product(partials))
+        return fp12_eq(out[None], fp12_one_like((1,)))[0]
 
 
 def sharded_verify_signature_sets(mesh: Mesh, sets, lanes: int,
@@ -176,10 +183,15 @@ def sharded_verify_signature_sets(mesh: Mesh, sets, lanes: int,
     bits_pk = k.scalars_to_bits(prep["pk_rands"], 64)
     bits_sig = k.scalars_to_bits(prep["sig_rands"], 64)
     g1_sharded, g2_sharded = _scalar_mul_fns(mesh, axis)
-    spx, spy, spz = g1_sharded(jnp.asarray(prep["pk_x"]),
-                               jnp.asarray(prep["pk_y"]),
-                               jnp.asarray(one1), jnp.asarray(bits_pk))
-    ssx, ssy, ssz = g2_sharded(sig_x, sig_y, one2, jnp.asarray(bits_sig))
+    with device.hbm_watermark("parallel.bls"):
+        spx, spy, spz = g1_sharded(jnp.asarray(prep["pk_x"]),
+                                   jnp.asarray(prep["pk_y"]),
+                                   jnp.asarray(one1),
+                                   jnp.asarray(bits_pk))
+        ssx, ssy, ssz = g2_sharded(sig_x, sig_y, one2,
+                                   jnp.asarray(bits_sig))
+        device.attribute("parallel.bls", "rlc_scaled_points",
+                         spx, spy, spz, ssx, ssy, ssz)
 
     # scaled-signature aggregate + per-message pubkey segment sums run on
     # the gathered scaled points (ICI gather of [lanes] points)
@@ -209,7 +221,9 @@ def sharded_verify_signature_sets(mesh: Mesh, sets, lanes: int,
     full_mask[:lanes] = mask
     full_mask[lanes] = True               # the one real aggregate lane
 
-    partials = _masked_product_fn(mesh, axis)(px, py, qx, qy,
-                                              jnp.asarray(full_mask))
-    out = final_exponentiation(fp12_product(partials))
+    with device.hbm_watermark("parallel.bls"):
+        device.attribute("parallel.bls", "miller_pairs", px, py, qx, qy)
+        partials = _masked_product_fn(mesh, axis)(px, py, qx, qy,
+                                                  jnp.asarray(full_mask))
+        out = final_exponentiation(fp12_product(partials))
     return bool(host_readback(fp12_eq(out[None], fp12_one_like((1,)))[0]))

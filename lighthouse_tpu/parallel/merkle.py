@@ -19,7 +19,8 @@ try:                              # jax >= 0.4.35 exports it at top level
 except ImportError:               # older jax: experimental location
     from jax.experimental.shard_map import shard_map
 
-from ..obs.jax_accounting import track_compiles
+from ..obs import device
+from ..obs.roofline import track_roofline
 from ..ops.sha256 import hash_pairs, merkleize_dense
 
 
@@ -39,8 +40,9 @@ def _sharded_merkleize_fn(mesh: Mesh, subtree_depth: int, top_depth: int,
                           axis: str):
     """Memoized jitted program per (mesh, depths): a fresh
     jit(shard_map(...)) per call would re-trace every call
-    (graftlint: recompile-hazard).  track_compiles() makes any leak past
-    the memoization an observable jax_compile_total increment."""
+    (graftlint: recompile-hazard).  track_roofline() makes any leak past
+    the memoization an observable jax_compile_total increment and scores
+    the program's cost_analysis against the platform peak (graftgauge)."""
     fn = shard_map(
         functools.partial(_subtree_then_top, subtree_depth=subtree_depth,
                           top_depth=top_depth, axis=axis),
@@ -48,7 +50,7 @@ def _sharded_merkleize_fn(mesh: Mesh, subtree_depth: int, top_depth: int,
         in_specs=(P(axis, None),),
         out_specs=P(axis, None),
     )
-    return track_compiles(
+    return track_roofline(
         f"merkle.subtree_d{subtree_depth}_t{top_depth}", jax.jit(fn))
 
 
@@ -65,8 +67,10 @@ def sharded_merkleize(mesh: Mesh, leaves: jax.Array,
     top_depth = (n_dev - 1).bit_length()
 
     # each shard returns the (identical) root; take shard 0's copy
-    out = _sharded_merkleize_fn(mesh, subtree_depth, top_depth,
-                                axis)(leaves.reshape(n, 8))
+    with device.hbm_watermark("parallel.merkle"):
+        device.attribute("parallel.merkle", "leaves", leaves)
+        out = _sharded_merkleize_fn(mesh, subtree_depth, top_depth,
+                                    axis)(leaves.reshape(n, 8))
     return out[0]
 
 

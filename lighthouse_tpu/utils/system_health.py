@@ -35,6 +35,14 @@ def _cpu_percent() -> float:
     return max(0.0, 100.0 * (cpu - mark[1]) / wall_d)
 
 
+def _open_fds() -> int:
+    """Open fd count via /proc; -1 where /proc is unavailable."""
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1
+
+
 def _meminfo() -> dict[str, int]:
     out = {}
     try:
@@ -72,8 +80,29 @@ def snapshot(data_dir: str = "/") -> dict:
         "network_node_bytes_total_received": 0,
         "network_node_bytes_total_transmit": 0,
     }
+    fds = _open_fds()
+    if fds >= 0:
+        out["process_num_open_file_descriptors"] = fds
+        metrics_defs.gauge("process_open_fds", fds)
     metrics_defs.gauge("system_load_1m", la1)
     metrics_defs.gauge("process_resident_memory_bytes", rss)
     metrics_defs.gauge("system_disk_free_bytes", disk_free)
     metrics_defs.gauge("process_cpu_percent", _cpu_percent())
     return out
+
+
+def sample_gauges() -> None:
+    """Cheap per-slot host-health feed for the graftwatch rings (the
+    full :func:`snapshot` does statvfs + meminfo too — overkill at slot
+    cadence).  Called from ``obs.device.publish`` each slot so RSS/CPU
+    trajectories land in the timeseries, not just on-demand snapshots."""
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    metrics_defs.gauge("process_resident_memory_bytes", rss)
+    metrics_defs.gauge("process_cpu_percent", _cpu_percent())
+    try:
+        metrics_defs.gauge("system_load_1m", os.getloadavg()[0])
+    except OSError:
+        pass
+    fds = _open_fds()
+    if fds >= 0:
+        metrics_defs.gauge("process_open_fds", fds)

@@ -9,20 +9,30 @@ import functools
 
 import jax
 
+from lighthouse_tpu.obs.roofline import track_roofline
+
 
 @functools.lru_cache(maxsize=None)
 def _budget_fn(lanes, n_dev):
-    return jax.jit(lambda x: x * 2)
+    # roofline-wrapped: silent under the pairing check
+    return track_roofline("fix.budget", jax.jit(lambda x: x * 2))
 
 
 @functools.lru_cache(maxsize=None)
 def _leak_fn(lanes):
-    return jax.jit(lambda x: x + 1)
+    return track_roofline("fix.leak", jax.jit(lambda x: x + 1))
 
 
 @functools.lru_cache(maxsize=None)
 def _pad_fn(lanes):
-    return jax.jit(lambda x: x)
+    return track_roofline("fix.pad", jax.jit(lambda x: x))
+
+
+@functools.lru_cache(maxsize=None)
+def _unmetered_fn(lanes):
+    # bare jax.jit out of a memoized factory: bypasses track_roofline,
+    # so its program would run without compile/cost accounting
+    return jax.jit(lambda x: x - 1)  # seeded
 
 
 def full_batch(x, lanes, n_dev):

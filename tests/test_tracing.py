@@ -369,13 +369,14 @@ def test_bls_factory_shape_change_increments_compile_counter():
     runs un-gated)."""
     import os
 
-    from lighthouse_tpu.obs.jax_accounting import TrackedJit
+    from lighthouse_tpu.obs.roofline import RooflineJit
     from lighthouse_tpu.parallel import batch_mesh
     from lighthouse_tpu.parallel.bls import _miller_product_fn
 
     mesh = batch_mesh(8)
     fn = _miller_product_fn(mesh, "batch")
-    assert isinstance(fn, TrackedJit)        # factories are tracked
+    # factories are roofline-wrapped (compile accounting + cost records)
+    assert isinstance(fn, RooflineJit)
     assert _miller_product_fn(mesh, "batch") is fn   # memoized
 
     if not os.environ.get("LHTPU_SLOW_TESTS"):

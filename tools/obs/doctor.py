@@ -3,6 +3,7 @@
 
     python tools/obs/doctor.py graftwatch_24_001_incident_head_lag.json
     python tools/obs/doctor.py --json dump.json      # machine-readable
+    python tools/obs/doctor.py --probe               # live device probe
 
 Loads a versioned dump written by the flight recorder (auto-dump on
 incident-open, /lighthouse/graftwatch/dump, or SIGUSR2) and correlates
@@ -10,6 +11,12 @@ every SLO breach in it with the co-occurring signals bundled alongside:
 runtime XLA recompiles, device transfer bytes, processor shedding and
 queue depth, reorgs, block-import throughput.  The breached metric's own
 trajectory always leads each incident's diagnosis.
+
+``--probe`` skips the dump entirely and runs the staged device-health
+probe (graftgauge): subprocesses answering "how far does JAX get on
+this host" under default init and under ``JAX_PLATFORMS=tpu``, each
+stage with its own hard timeout so a wedged libtpu acquisition reports
+instead of hanging.
 
 Exit codes: 0 report produced, 2 unreadable/invalid dump, 3 dump format
 version unsupported.
@@ -29,10 +36,35 @@ from lighthouse_tpu.obs import doctor  # noqa: E402
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("path", help="flight-recorder dump file")
+    ap.add_argument("path", nargs="?", help="flight-recorder dump file")
     ap.add_argument("--json", action="store_true",
                     help="print the diagnosis as JSON instead of text")
+    ap.add_argument("--probe", action="store_true",
+                    help="run the staged device-health probe instead of "
+                         "reading a dump")
+    ap.add_argument("--probe-timeout", type=int, default=90,
+                    help="per-stage probe timeout in seconds")
     args = ap.parse_args(argv)
+    if args.probe:
+        from lighthouse_tpu.obs import device  # noqa: E402
+        probe = device.staged_probe(timeout=args.probe_timeout,
+                                    cwd=str(REPO))
+        if args.json:
+            print(json.dumps(probe, indent=2))
+        else:
+            print(f"device probe (per-stage timeout "
+                  f"{probe['timeout_s']}s)")
+            for label in ("default", "forced_tpu"):
+                rec = probe.get(label) or {}
+                print(f"  {label}: reached stage "
+                      f"{rec.get('stage_reached')}")
+                for stage, st in (rec.get("stages") or {}).items():
+                    rc = st.get("rc")
+                    rc_s = "timeout" if rc is None else f"rc {rc}"
+                    print(f"    {stage}: {rc_s} in {st.get('wall_s')}s")
+        return 0
+    if not args.path:
+        ap.error("path required unless --probe")
     try:
         doc = doctor.load(args.path)
     except doctor.DoctorError as e:
